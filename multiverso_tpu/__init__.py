@@ -1,0 +1,159 @@
+"""multiverso_tpu — a TPU-native parameter-server framework.
+
+Capability-parity rebuild of the Multiverso parameter-server framework
+(reference: ``include/multiverso/multiverso.h``, ``src/multiverso.cpp``,
+``binding/python/multiverso/api.py``) re-founded on JAX/XLA: table shards are
+``jax.Array``s in HBM over a device mesh, Get/Add are jitted gathers and
+donated scatter-updates, server-side optimizers are pure jitted functions,
+and the allreduce path is ``psum``/host-collectives instead of MPI.
+
+Public surface (MV_* parity):
+
+    init / shutdown / barrier
+    rank / size / num_workers / num_servers / worker_id / server_id
+    worker_id_to_rank / server_id_to_rank / is_master_worker
+    set_flag / parse_cmd_flags
+    aggregate                      (MV_Aggregate: in-place-sum allreduce)
+    ArrayTable / MatrixTable / KVTable handles (create_table factory)
+    worker(slot)                   (bind a logical worker context to a thread)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from multiverso_tpu import config as _config
+from multiverso_tpu import log  # noqa: F401  (re-export)
+from multiverso_tpu.config import get_flag, parse_cmd_flags, set_flag  # noqa: F401
+from multiverso_tpu.dashboard import Dashboard, Timer, monitor  # noqa: F401
+from multiverso_tpu.runtime.node import Role  # noqa: F401
+from multiverso_tpu.runtime.zoo import Zoo
+
+__version__ = "0.1.0"
+
+
+# -- lifecycle (MV_Init / MV_ShutDown / MV_Barrier) -------------------------
+
+def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
+         **flag_overrides: Any) -> list:
+    """Bring up the runtime. ``argv`` accepts ``-key=value`` tokens (CLI
+    parity); keyword overrides hit the same flag registry
+    (e.g. ``init(sync=True, local_workers=4)``)."""
+    if sync is not None:
+        set_flag("sync", sync)
+    for key, value in flag_overrides.items():
+        set_flag(key, value)
+    return Zoo.instance().start(argv)
+
+
+def shutdown(finalize_net: bool = True) -> None:
+    Zoo.instance().stop(finalize_net)
+
+
+def barrier() -> None:
+    Zoo.instance().barrier()
+
+
+# -- identity ---------------------------------------------------------------
+
+def rank() -> int:
+    return Zoo.instance().rank
+
+
+def size() -> int:
+    return Zoo.instance().size
+
+
+def num_workers() -> int:
+    return Zoo.instance().num_workers
+
+
+def workers_num() -> int:  # python-binding spelling
+    return num_workers()
+
+
+def num_servers() -> int:
+    return Zoo.instance().num_servers
+
+
+def server_num() -> int:  # python-binding spelling
+    return num_servers()
+
+
+def worker_id() -> int:
+    return Zoo.instance().current_worker_id()
+
+
+def server_id() -> int:
+    return Zoo.instance().node.server_id
+
+
+def worker_id_to_rank(wid: int) -> int:
+    return Zoo.instance().worker_id_to_rank(wid)
+
+
+def server_id_to_rank(sid: int) -> int:
+    return Zoo.instance().server_id_to_rank(sid)
+
+
+def is_master_worker() -> bool:
+    """Worker 0 seeds shared state (python-binding contract)."""
+    return worker_id() == 0
+
+
+@contextlib.contextmanager
+def worker(local_slot: int) -> Iterator[int]:
+    """Bind the calling thread to logical worker context ``local_slot``."""
+    zoo = Zoo.instance()
+    zoo.bind_worker(local_slot)
+    try:
+        yield zoo.rank * zoo.local_workers + local_slot
+    finally:
+        zoo.bind_worker(0)
+
+
+# -- collectives (MV_Aggregate) ---------------------------------------------
+
+def aggregate(data: np.ndarray) -> np.ndarray:
+    """Elementwise sum of ``data`` across every worker; every caller gets the
+    summed result (in-place-sum semantics of ``MV_Aggregate``)."""
+    return Zoo.instance().aggregate(data)
+
+
+# -- tables -----------------------------------------------------------------
+
+from multiverso_tpu.tables.array_table import ArrayServer, ArrayWorker  # noqa: E402
+from multiverso_tpu.tables.kv_table import KVServer, KVWorker  # noqa: E402
+from multiverso_tpu.tables.matrix_table import MatrixServer, MatrixWorker  # noqa: E402
+from multiverso_tpu.updaters import AddOption, GetOption  # noqa: E402,F401
+
+ArrayTableHandler = ArrayWorker  # python-binding names
+MatrixTableHandler = MatrixWorker
+
+_TABLE_TYPES = {
+    "array": ArrayWorker,
+    "matrix": MatrixWorker,
+    "kv": KVWorker,
+}
+
+
+def create_table(kind: str, *args: Any, **kwargs: Any):
+    """``MV_CreateTable`` parity: construct a worker/server table pair (the
+    server side registers with the dispatcher automatically)."""
+    try:
+        cls = _TABLE_TYPES[kind]
+    except KeyError:
+        log.fatal("unknown table kind %r (have: %s)", kind, sorted(_TABLE_TYPES))
+    table = cls(*args, **kwargs)
+    # table creation happens once per process; sync processes, not local workers
+    Zoo.instance().process_barrier()
+    return table
+
+
+def register_table_type(kind: str, factory: Any) -> None:
+    """Table-extension API: reference apps register custom tables
+    (LogisticRegression's Sparse/FTRL tables); same seam here."""
+    _TABLE_TYPES[kind] = factory

@@ -1,0 +1,130 @@
+"""Checkpoint / resume: table Store/Load over the Stream layer + a periodic
+driver.
+
+Reference capability (not copied): every ``ServerTable`` is ``Serializable``
+with ``Store(Stream*)/Load(Stream*)`` over the URI/Stream IO layer
+(``include/multiverso/table_interface.h:61-75``), but nothing in the snapshot
+drove them on a schedule — the Dockerfile's lost ``checkpoint``/``restore``
+test targets show it was a supported workflow. The rebuild ships the hooks
+AND an actual driver.
+
+Format: a tiny self-describing binary header (dtype, ndim, dims) per array —
+stable across hosts, independent of pickle. ``CheckpointDriver`` snapshots
+every N seconds or every N steps to ``<uri>/table_<id>.mvckpt``.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from multiverso_tpu import io as mv_io
+from multiverso_tpu import log
+
+_MAGIC = b"MVTC"
+
+
+def write_array(stream: mv_io.Stream, arr: np.ndarray) -> None:
+    arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode("ascii")
+    stream.write(_MAGIC)
+    stream.write(struct.pack("<B", len(dt)))
+    stream.write(dt)
+    stream.write(struct.pack("<B", arr.ndim))
+    stream.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+    stream.write(arr.tobytes())
+
+
+def read_array(stream: mv_io.Stream) -> np.ndarray:
+    magic = stream.read(4)
+    if magic != _MAGIC:
+        log.fatal("checkpoint: bad magic %r", magic)
+    (dtlen,) = struct.unpack("<B", stream.read(1))
+    dtype = np.dtype(stream.read(dtlen).decode("ascii"))
+    (ndim,) = struct.unpack("<B", stream.read(1))
+    shape = struct.unpack(f"<{ndim}q", stream.read(8 * ndim))
+    count = int(np.prod(shape)) if ndim else 1
+    data = stream.read(count * dtype.itemsize)
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+def store_table(table, address: str) -> None:
+    """Store one table (worker or server handle) to a URI."""
+    server = getattr(table, "_server_table", table)
+    with mv_io.get_stream(address, "w") as stream:
+        server.store(stream)
+
+
+def load_table(table, address: str) -> None:
+    server = getattr(table, "_server_table", table)
+    with mv_io.get_stream(address, "r") as stream:
+        server.load(stream)
+
+
+class CheckpointDriver:
+    """Periodic snapshot driver over a set of tables.
+
+    ``interval_steps``: snapshot on every Nth ``step()`` call;
+    ``interval_seconds``: or on a wall-clock timer thread. Snapshots are
+    written to ``<directory>/table_<id>.mvckpt`` with an atomic rename.
+    """
+
+    def __init__(self, tables: List, directory: str,
+                 interval_steps: Optional[int] = None,
+                 interval_seconds: Optional[float] = None) -> None:
+        self.tables = list(tables)
+        self.directory = directory
+        self.interval_steps = interval_steps
+        self.interval_seconds = interval_seconds
+        self._step = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(directory, exist_ok=True)
+        if interval_seconds:
+            self._thread = threading.Thread(target=self._timer_loop, daemon=True)
+            self._thread.start()
+
+    def _timer_loop(self) -> None:
+        while not self._stop.wait(self.interval_seconds):
+            self.snapshot()
+
+    def step(self) -> None:
+        self._step += 1
+        if self.interval_steps and self._step % self.interval_steps == 0:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        with self._lock:
+            for table in self.tables:
+                server = getattr(table, "_server_table", table)
+                tid = getattr(server, "table_id", 0)
+                final = os.path.join(self.directory, f"table_{tid}.mvckpt")
+                tmp = final + ".tmp"
+                store_table(table, tmp)
+                os.replace(tmp, final)
+            log.debug("checkpoint: snapshot of %d tables -> %s",
+                      len(self.tables), self.directory)
+
+    def restore(self) -> bool:
+        """Load the latest snapshot; returns False when none exists."""
+        with self._lock:
+            loaded = False
+            for table in self.tables:
+                server = getattr(table, "_server_table", table)
+                tid = getattr(server, "table_id", 0)
+                path = os.path.join(self.directory, f"table_{tid}.mvckpt")
+                if os.path.exists(path):
+                    load_table(table, path)
+                    loaded = True
+            return loaded
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

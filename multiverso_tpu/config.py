@@ -1,0 +1,162 @@
+"""Typed flag registry — TPU-native re-design of Multiverso's configure system.
+
+Reference capability (not copied): a gflags-like static registration system
+(``include/multiverso/util/configure.h:20-114``, ``src/util/configure.cpp:9-54``)
+with ``MV_DEFINE_<type>(name, default, text)`` macros, ``-name=value`` CLI
+parsing that compacts argv, and programmatic ``MV_SetFlag``.
+
+This module provides the same capability surface for the TPU rebuild:
+
+* ``define_int / define_bool / define_string / define_double`` — typed flag
+  registration with defaults and help text.
+* ``parse_cmd_flags(argv)`` — parses ``-name=value`` (and ``--name=value``)
+  tokens, removes them from argv, returns the compacted list.
+* ``set_flag(name, value)`` / ``get_flag(name)`` — programmatic access used by
+  bindings (the reference's Python binding passes ``-sync=true`` as fake argv;
+  here both paths hit the same registry).
+
+Flags are process-global, matching the reference's static registry semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+class FlagError(ValueError):
+    """Raised on unknown flag access or unparsable flag values."""
+
+
+def _parse_bool(text: str) -> bool:
+    t = text.strip().lower()
+    if t in ("true", "1", "yes", "on"):
+        return True
+    if t in ("false", "0", "no", "off"):
+        return False
+    raise FlagError(f"cannot parse boolean flag value: {text!r}")
+
+
+@dataclass
+class _Flag:
+    name: str
+    value: Any
+    default: Any
+    parser: Callable[[str], Any]
+    help_text: str
+
+
+class FlagRegistry:
+    """Thread-safe typed flag store. One global instance (`FLAGS`) mirrors the
+    reference's static registry; separate instances exist for tests."""
+
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.RLock()
+
+    # -- registration ------------------------------------------------------
+    def define(self, name: str, default: Any, parser: Callable[[str], Any],
+               help_text: str = "") -> None:
+        with self._lock:
+            if name in self._flags:
+                # Re-definition keeps the first registration, like static init.
+                return
+            self._flags[name] = _Flag(name, default, default, parser, help_text)
+
+    def define_int(self, name: str, default: int, help_text: str = "") -> None:
+        self.define(name, int(default), int, help_text)
+
+    def define_bool(self, name: str, default: bool, help_text: str = "") -> None:
+        self.define(name, bool(default), _parse_bool, help_text)
+
+    def define_string(self, name: str, default: str, help_text: str = "") -> None:
+        self.define(name, str(default), str, help_text)
+
+    def define_double(self, name: str, default: float, help_text: str = "") -> None:
+        self.define(name, float(default), float, help_text)
+
+    # -- access ------------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with self._lock:
+            try:
+                return self._flags[name].value
+            except KeyError:
+                raise FlagError(f"unknown flag: {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        """Programmatic set (``MV_SetFlag`` parity). Accepts either the typed
+        value or a string to be parsed with the flag's parser."""
+        with self._lock:
+            try:
+                flag = self._flags[name]
+            except KeyError:
+                raise FlagError(f"unknown flag: {name!r}") from None
+            if isinstance(value, str) and not isinstance(flag.default, str):
+                flag.value = flag.parser(value)
+            else:
+                flag.value = type(flag.default)(value)
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                for f in self._flags.values():
+                    f.value = f.default
+            else:
+                self._flags[name].value = self._flags[name].default
+
+    def known(self, name: str) -> bool:
+        with self._lock:
+            return name in self._flags
+
+    def items(self) -> Dict[str, Any]:
+        with self._lock:
+            return {k: f.value for k, f in self._flags.items()}
+
+    # -- CLI ---------------------------------------------------------------
+    def parse_cmd_flags(self, argv: Optional[List[str]]) -> List[str]:
+        """Parse ``-key=value`` / ``--key=value`` tokens; unknown flags and
+        non-flag tokens are kept, parsed flags are removed (argv compaction,
+        matching the reference parser's contract)."""
+        if not argv:
+            return []
+        remaining: List[str] = []
+        for token in argv:
+            if token.startswith("-") and "=" in token:
+                key, _, raw = token.lstrip("-").partition("=")
+                with self._lock:
+                    flag = self._flags.get(key)
+                if flag is not None:
+                    flag.value = flag.parser(raw)
+                    continue
+            remaining.append(token)
+        return remaining
+
+
+# Process-global registry (reference: static registry in configure.cpp).
+FLAGS = FlagRegistry()
+
+define_int = FLAGS.define_int
+define_bool = FLAGS.define_bool
+define_string = FLAGS.define_string
+define_double = FLAGS.define_double
+get_flag = FLAGS.get
+set_flag = FLAGS.set
+parse_cmd_flags = FLAGS.parse_cmd_flags
+
+
+# Core runtime flags (superset of the reference's flag list, §2.1 "Config"):
+define_string("ps_role", "default", "node role: worker|server|default(all)|none")
+define_bool("ma", False, "model-averaging mode: skip PS tables, aggregate() only")
+define_bool("sync", False, "synchronous (BSP) parameter server")
+define_double("backup_worker_ratio", 0.0, "fraction of workers treated as backups")
+define_string("updater_type", "default", "server-side optimizer: default|sgd|adagrad|momentum_sgd|dcasgd")
+define_int("omp_threads", 4, "host-side worker threads for CPU fallbacks")
+define_bool("is_pipelined", False, "double-buffered pipelined get")
+define_int("allocator_alignment", 16, "host buffer alignment (native allocator)")
+define_string("allocator_type", "smart", "host allocator: smart|default")
+define_string("machine_file", "", "multi-host machine list (external transport)")
+define_int("port", 55555, "external transport port")
+define_string("mesh_shape", "", "device mesh shape, e.g. '2x4'; empty = auto 1-D")
+define_string("mesh_axes", "server", "comma-separated mesh axis names")
+define_bool("deterministic", False, "force deterministic apply order in async mode")

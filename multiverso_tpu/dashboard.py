@@ -1,0 +1,134 @@
+"""Named section timers (Monitor/Dashboard) — tracing & profiling subsystem.
+
+Reference capability (not copied): statically-registered named section timers
+via ``MONITOR_BEGIN/END`` macros aggregating count/total/average, with a
+global ``Dashboard::Watch/Display`` (``include/multiverso/dashboard.h:16-75``,
+``src/dashboard.cpp:14-49``).
+
+TPU-era additions: monitors double as ``jax.profiler.TraceAnnotation`` scopes
+when profiling is enabled, so named sections show up in TPU traces; the timer
+is a context manager / decorator instead of macro pairs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from contextlib import contextmanager
+
+try:  # profiler annotations are optional — pure-host use works without jax
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover
+    _TraceAnnotation = None
+
+
+class Monitor:
+    """count / total-elapse / average for one named code section."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._count = 0
+        self._elapse = 0.0  # seconds
+        self._begin: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def begin(self) -> None:
+        self._begin = time.perf_counter()
+
+    def end(self) -> None:
+        if self._begin is None:
+            return
+        dt = time.perf_counter() - self._begin
+        self._begin = None
+        with self._lock:
+            self._count += 1
+            self._elapse += dt
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def elapse_ms(self) -> float:
+        return self._elapse * 1e3
+
+    @property
+    def average_ms(self) -> float:
+        return self.elapse_ms / self._count if self._count else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count = 0
+            self._elapse = 0.0
+            self._begin = None
+
+    def __repr__(self) -> str:
+        return (f"Monitor({self.name}: count={self.count}, "
+                f"elapse={self.elapse_ms:.3f}ms, average={self.average_ms:.3f}ms)")
+
+
+class Dashboard:
+    """Global registry of monitors (reference: ``Dashboard::Watch/Display``)."""
+
+    _monitors: Dict[str, Monitor] = {}
+    _lock = threading.Lock()
+    profile_annotations: bool = False
+
+    @classmethod
+    def get(cls, name: str) -> Monitor:
+        with cls._lock:
+            mon = cls._monitors.get(name)
+            if mon is None:
+                mon = cls._monitors[name] = Monitor(name)
+            return mon
+
+    @classmethod
+    def watch(cls, name: str) -> Optional[Monitor]:
+        with cls._lock:
+            return cls._monitors.get(name)
+
+    @classmethod
+    def display(cls) -> str:
+        with cls._lock:
+            lines = ["--------------Dashboard--------------------"]
+            lines.extend(repr(m) for m in cls._monitors.values())
+        text = "\n".join(lines)
+        print(text, flush=True)
+        return text
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._monitors.clear()
+
+
+@contextmanager
+def monitor(name: str) -> Iterator[Monitor]:
+    """``MONITOR_BEGIN(name) ... MONITOR_END(name)`` as a context manager."""
+    mon = Dashboard.get(name)
+    mon.begin()
+    ann = None
+    if Dashboard.profile_annotations and _TraceAnnotation is not None:
+        ann = _TraceAnnotation(name)
+        ann.__enter__()
+    try:
+        yield mon
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        mon.end()
+
+
+class Timer:
+    """Chrono stopwatch in ms (reference: ``util/timer.h``)."""
+
+    def __init__(self) -> None:
+        self.start()
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def elapse_ms(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e3

@@ -1,0 +1,174 @@
+"""URI-dispatched stream IO — capability parity with the reference IO layer.
+
+Reference capability (not copied): ``URI`` parse + ``Stream`` abstraction with
+scheme-dispatched factories (``file://`` local stdio stream, ``hdfs://``
+libhdfs), plus a ``TextReader`` line reader
+(``include/multiverso/io/io.h:24-82``, ``src/io/io.cpp``, ``src/io/local_stream.cpp``).
+
+TPU-era design: the factory is an open registry so cloud schemes (``gs://``
+via tensorstore/orbax) can plug in; checkpointing (checkpoint.py) rides this
+layer exactly like the reference's ServerTable::Store/Load rides Stream.
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import os
+from dataclasses import dataclass
+from typing import BinaryIO, Callable, Dict, Optional
+
+from multiverso_tpu import log
+
+
+@dataclass
+class URI:
+    """Parsed resource locator: ``scheme://host/path`` (scheme defaults to file)."""
+
+    scheme: str
+    host: str
+    path: str
+    raw: str
+
+    @classmethod
+    def parse(cls, address: str) -> "URI":
+        if "://" not in address:
+            return cls(scheme="file", host="", path=address, raw=address)
+        scheme, _, rest = address.partition("://")
+        if scheme == "file":
+            return cls(scheme="file", host="", path=rest or "/", raw=address)
+        host, sep, path = rest.partition("/")
+        return cls(scheme=scheme, host=host, path=(sep + path) if sep else "", raw=address)
+
+
+class Stream:
+    """Binary stream interface (reference: ``Stream::Write/Read``)."""
+
+    def write(self, data: bytes) -> int:
+        raise NotImplementedError
+
+    def read(self, size: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def good(self) -> bool:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LocalStream(Stream):
+    """``file://`` stream over host stdio."""
+
+    def __init__(self, path: str, mode: str = "r") -> None:
+        binary_mode = mode if "b" in mode else mode + "b"
+        if "w" in mode or "a" in mode:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+        self._path = path
+        self._fp: Optional[BinaryIO] = None
+        try:
+            self._fp = open(path, binary_mode)
+        except OSError as exc:
+            log.error("LocalStream: cannot open %s (%s)", path, exc)
+
+    def write(self, data: bytes) -> int:
+        if self._fp is None:
+            log.fatal("LocalStream.write on bad stream %s", self._path)
+        return self._fp.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        if self._fp is None:
+            log.fatal("LocalStream.read on bad stream %s", self._path)
+        return self._fp.read(size)
+
+    def good(self) -> bool:
+        return self._fp is not None
+
+    def flush(self) -> None:
+        if self._fp is not None:
+            self._fp.flush()
+
+    def close(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+
+class MemoryStream(Stream):
+    """In-memory stream — used by tests and the wire-format round-trips."""
+
+    def __init__(self, data: bytes = b"") -> None:
+        self._buf = _pyio.BytesIO(data)
+
+    def write(self, data: bytes) -> int:
+        return self._buf.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        return self._buf.read(size)
+
+    def good(self) -> bool:
+        return True
+
+    def seek(self, pos: int) -> None:
+        self._buf.seek(pos)
+
+    def getvalue(self) -> bytes:
+        return self._buf.getvalue()
+
+
+_FACTORIES: Dict[str, Callable[[URI, str], Stream]] = {}
+
+
+def register_scheme(scheme: str, factory: Callable[[URI, str], Stream]) -> None:
+    _FACTORIES[scheme] = factory
+
+
+register_scheme("file", lambda uri, mode: LocalStream(uri.path, mode))
+
+
+def get_stream(address: str, mode: str = "r") -> Stream:
+    """StreamFactory::GetStream parity: dispatch on URI scheme."""
+    uri = URI.parse(address)
+    factory = _FACTORIES.get(uri.scheme)
+    if factory is None:
+        log.fatal("Can not support the protocol: %s", uri.scheme)
+    return factory(uri, mode)
+
+
+class TextReader:
+    """Buffered line reader over a Stream (reference: ``TextReader::GetLine``)."""
+
+    def __init__(self, address: str, buf_size: int = 1 << 16) -> None:
+        self._stream = get_stream(address, "r")
+        self._buf_size = buf_size
+        self._pending = b""
+        self._eof = False
+
+    def get_line(self) -> Optional[str]:
+        while True:
+            nl = self._pending.find(b"\n")
+            if nl >= 0:
+                line, self._pending = self._pending[:nl], self._pending[nl + 1:]
+                return line.decode("utf-8", errors="replace").rstrip("\r")
+            if self._eof:
+                if self._pending:
+                    line, self._pending = self._pending, b""
+                    return line.decode("utf-8", errors="replace").rstrip("\r")
+                return None
+            chunk = self._stream.read(self._buf_size)
+            if not chunk:
+                self._eof = True
+            else:
+                self._pending += chunk
+
+    def close(self) -> None:
+        self._stream.close()

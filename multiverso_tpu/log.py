@@ -1,0 +1,102 @@
+"""Leveled logger + CHECK macros — capability parity with the reference logger.
+
+Reference capability (not copied): a leveled (Debug/Info/Error/Fatal) logger
+with a static facade, optional file sink, and ``CHECK``/``CHECK_NOTNULL``
+macros that fatal on failure (``include/multiverso/util/log.h:9-142``).
+
+TPU-era notes: Fatal raises :class:`FatalError` instead of aborting the
+process by default (a JAX host process may own device buffers that deserve
+cleanup); ``set_kill_on_fatal(True)`` restores abort semantics for
+drop-in-compatible hosts.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import sys
+import threading
+import time
+from typing import Any, Optional, TextIO
+
+
+class LogLevel(enum.IntEnum):
+    DEBUG = 0
+    INFO = 1
+    ERROR = 2
+    FATAL = 3
+
+
+class FatalError(RuntimeError):
+    """Raised by Log.fatal / failed CHECKs when kill_on_fatal is off."""
+
+
+class Logger:
+    def __init__(self, level: LogLevel = LogLevel.INFO) -> None:
+        self._level = level
+        self._file: Optional[TextIO] = None
+        self._lock = threading.Lock()
+        self._kill_on_fatal = False
+
+    def reset_log_level(self, level: LogLevel) -> None:
+        self._level = level
+
+    def reset_log_file(self, filename: str = "") -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            if filename:
+                self._file = open(filename, "a", encoding="utf-8")
+
+    def set_kill_on_fatal(self, kill: bool) -> None:
+        self._kill_on_fatal = kill
+
+    def _emit(self, level: LogLevel, msg: str) -> None:
+        if level < self._level:
+            return
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime())
+        line = f"[{level.name}] [{stamp}] [pid:{os.getpid()}] {msg}"
+        with self._lock:
+            stream = sys.stderr if level >= LogLevel.ERROR else sys.stdout
+            print(line, file=stream, flush=True)
+            if self._file is not None:
+                print(line, file=self._file, flush=True)
+
+    def debug(self, fmt: str, *args: Any) -> None:
+        self._emit(LogLevel.DEBUG, fmt % args if args else fmt)
+
+    def info(self, fmt: str, *args: Any) -> None:
+        self._emit(LogLevel.INFO, fmt % args if args else fmt)
+
+    def error(self, fmt: str, *args: Any) -> None:
+        self._emit(LogLevel.ERROR, fmt % args if args else fmt)
+
+    def fatal(self, fmt: str, *args: Any) -> None:
+        msg = fmt % args if args else fmt
+        self._emit(LogLevel.FATAL, msg)
+        if self._kill_on_fatal:
+            os._exit(1)
+        raise FatalError(msg)
+
+
+# Static facade (reference: `Log` static class).
+LOG = Logger()
+debug = LOG.debug
+info = LOG.info
+error = LOG.error
+fatal = LOG.fatal
+reset_log_level = LOG.reset_log_level
+reset_log_file = LOG.reset_log_file
+
+
+def check(condition: Any, msg: str = "CHECK failed") -> None:
+    """``CHECK(cond)`` parity: fatal when the condition is falsy."""
+    if not condition:
+        LOG.fatal(msg)
+
+
+def check_notnull(value: Any, name: str = "pointer") -> Any:
+    if value is None:
+        LOG.fatal(f"CHECK_NOTNULL failed: {name} is None")
+    return value

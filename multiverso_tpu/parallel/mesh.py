@@ -1,0 +1,69 @@
+"""Device mesh construction and sharding helpers.
+
+This is the substrate that replaces the reference's rank topology: server
+"shards" are device shards of a :class:`jax.sharding.Mesh` axis instead of
+MPI ranks (reference range sharding: ``src/table/array_table.cpp:13-19``,
+``src/table/matrix_table.cpp:25-45``).
+
+Design: one global *table mesh* (axis ``server``) owns parameter-table
+placement; applications build richer meshes (data/model/pipeline axes) for
+their own compute and the tables interoperate because Get/Add results cross
+via host or via resharding.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def parse_mesh_shape(text: str) -> Optional[Tuple[int, ...]]:
+    """Parse '2x4'-style mesh shape flags; empty → None (auto 1-D)."""
+    text = text.strip()
+    if not text:
+        return None
+    return tuple(int(tok) for tok in text.replace("*", "x").split("x"))
+
+
+def build_mesh(devices: Optional[Sequence[jax.Device]] = None,
+               shape: Optional[Tuple[int, ...]] = None,
+               axis_names: Sequence[str] = ("server",)) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def table_sharding(mesh: Mesh, ndim: int, shard_dim: int = 0,
+                   axis: str = "server") -> NamedSharding:
+    """Sharding for a table state array: dimension ``shard_dim`` split over
+    the server axis (reference analog: range sharding over server ranks)."""
+    spec = [None] * ndim
+    spec[shard_dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh, ndim: int = 0) -> NamedSharding:
+    return NamedSharding(mesh, P(*([None] * ndim)))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    """Smallest multiple of k that is >= n (shard-divisibility padding)."""
+    return ((n + k - 1) // k) * k
+
+
+def shard_ranges(total: int, num_shards: int) -> List[Tuple[int, int]]:
+    """Equal-chunk ranges with remainder to the last shard — mirrors the
+    reference's server offset computation so `server_id`-indexed APIs
+    (e.g. checkpoint-per-shard naming) agree with its layout."""
+    chunk = total // num_shards
+    ranges = []
+    for i in range(num_shards):
+        begin = chunk * i
+        end = total if i == num_shards - 1 else chunk * (i + 1)
+        ranges.append((begin, end))
+    return ranges

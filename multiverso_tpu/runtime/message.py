@@ -1,0 +1,78 @@
+"""Request/Reply message model for the host-side dispatcher.
+
+Reference capability (not copied): ``Message``/``MsgType`` wire protocol —
+8-int header (src, dst, type, table_id, msg_id) + blob payload, with a
+reply constructor that negates the type
+(``include/multiverso/message.h:13-66``).
+
+TPU-era role: on the SPMD substrate there is no wire — requests travel from
+worker contexts to the dispatcher through an in-process queue, and the
+"payload" is numpy/jax arrays. The type taxonomy (and its sign convention:
+positive → server-bound request, negative → worker-bound reply, >=32 →
+control) is preserved because the consistency machinery (sync server clocks,
+barrier) and the external C-API bridge both dispatch on it.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+class MsgType(enum.IntEnum):
+    # server-bound requests (positive, < 32)
+    Request_Get = 1
+    Request_Add = 2
+    Server_Finish_Train = 31
+    # worker-bound replies (negative)
+    Reply_Get = -1
+    Reply_Add = -2
+    # control plane (>= 32 request, <= -32 reply)
+    Control_Barrier = 33
+    Control_Reply_Barrier = -33
+    Control_Register = 34
+    Control_Reply_Register = -34
+
+    @property
+    def is_server_bound(self) -> bool:
+        return 0 < self.value < 32
+
+    @property
+    def is_worker_bound(self) -> bool:
+        return self.value < 0
+
+    @property
+    def is_control(self) -> bool:
+        return abs(self.value) >= 32
+
+
+_msg_id_counter = itertools.count(1)
+_msg_id_lock = threading.Lock()
+
+
+def next_msg_id() -> int:
+    with _msg_id_lock:
+        return next(_msg_id_counter)
+
+
+@dataclass
+class Message:
+    src: int = -1
+    dst: int = -1
+    type: MsgType = MsgType.Request_Get
+    table_id: int = -1
+    msg_id: int = 0
+    data: List[Any] = field(default_factory=list)
+
+    def create_reply(self) -> "Message":
+        """Reply retraces the path: swap src/dst, negate type."""
+        return Message(
+            src=self.dst,
+            dst=self.src,
+            type=MsgType(-int(self.type)),
+            table_id=self.table_id,
+            msg_id=self.msg_id,
+        )

@@ -1,0 +1,43 @@
+"""Node/Role metadata (reference: ``include/multiverso/node.h:6-27``)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Role(enum.IntFlag):
+    NONE = 0
+    WORKER = 1
+    SERVER = 2
+    ALL = 3
+
+    @classmethod
+    def from_string(cls, text: str) -> "Role":
+        table = {
+            "none": cls.NONE,
+            "worker": cls.WORKER,
+            "server": cls.SERVER,
+            "default": cls.ALL,
+            "all": cls.ALL,
+        }
+        try:
+            return table[text.strip().lower()]
+        except KeyError:
+            raise ValueError(f"unknown ps_role: {text!r}") from None
+
+
+@dataclass
+class Node:
+    rank: int = 0
+    role: Role = Role.ALL
+    worker_id: int = -1
+    server_id: int = -1
+
+    @property
+    def is_worker(self) -> bool:
+        return bool(self.role & Role.WORKER)
+
+    @property
+    def is_server(self) -> bool:
+        return bool(self.role & Role.SERVER)

@@ -1,0 +1,212 @@
+"""Server runtime: the dispatcher that owns table state and applies requests.
+
+Reference capability (not copied): the ``Server`` actor owns the
+``ServerTable`` store, applies Adds and answers Gets; the ``SyncServer``
+subclass implements BSP via per-worker vector clocks and deferred-message
+caches (``src/server.cpp:36-222``). Routing ran worker actor → communicator →
+network → server actor.
+
+TPU-native re-design: table state is a sharded ``jax.Array`` in HBM; "apply
+an Add" is a jitted donated updater call; "answer a Get" is a device gather +
+host fetch. The actor zoo collapses to ONE dispatcher thread per process
+pulling typed messages from an in-process queue — the network hop no longer
+exists because workers and server shards share the mesh. The BSP contract is
+preserved exactly (and tested like ``Test/unittests/test_sync.cpp``):
+*every worker's i-th Get observes exactly i rounds of every worker's Adds*,
+implemented with the same two-sided clock: round-(i+1) Adds are deferred
+until all round-i Gets are served, round-i Gets are deferred until all
+round-i Adds are applied.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from multiverso_tpu import config, log
+from multiverso_tpu.dashboard import monitor
+from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.utils import MtQueue
+
+
+class Server:
+    """Async parameter server dispatcher (reference: async ``Server``).
+
+    One background thread applies requests in arrival order. Asynchrony is
+    real: ``add_async`` returns once the message is queued; the device update
+    happens on the dispatcher thread, overlapping the caller's compute.
+    """
+
+    def __init__(self, num_workers: int) -> None:
+        self.num_workers = num_workers
+        self._tables: Dict[int, "object"] = {}  # table_id -> ServerTable
+        self._queue: MtQueue[Message] = MtQueue()
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._main, name="mv-server", daemon=True)
+        self._thread.start()
+        self._started.wait()
+
+    def stop(self) -> None:
+        self._queue.exit()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def register_table(self, server_table) -> int:
+        table_id = len(self._tables)
+        self._tables[table_id] = server_table
+        return table_id
+
+    def table(self, table_id: int):
+        return self._tables[table_id]
+
+    # -- client side -------------------------------------------------------
+    def send(self, msg: Message) -> None:
+        self._queue.push(msg)
+
+    # -- dispatcher --------------------------------------------------------
+    def _main(self) -> None:
+        self._started.set()
+        while True:
+            msg = self._queue.pop()
+            if msg is None:
+                return
+            try:
+                self._dispatch(msg)
+            except Exception as exc:  # keep the dispatcher alive; fail the waiter
+                log.error("server dispatcher error on %s: %r", msg.type, exc)
+                if msg.data and hasattr(msg.data[-1], "fail"):
+                    msg.data[-1].fail(exc)
+
+    def _dispatch(self, msg: Message) -> None:
+        if msg.type == MsgType.Request_Add:
+            self._process_add(msg)
+        elif msg.type == MsgType.Request_Get:
+            self._process_get(msg)
+        elif msg.type == MsgType.Server_Finish_Train:
+            self._process_finish_train(msg)
+        else:
+            log.error("server: unhandled message type %s", msg.type)
+
+    def _process_add(self, msg: Message) -> None:
+        with monitor("WORKER_PROCESS_ADD_MSG"):
+            request, completion = msg.data
+            self._tables[msg.table_id].process_add(request)
+            completion.done(None)
+
+    def _process_get(self, msg: Message) -> None:
+        with monitor("WORKER_PROCESS_GET_MSG"):
+            request, completion = msg.data
+            result = self._tables[msg.table_id].process_get(request)
+            completion.done(result)
+
+    def _process_finish_train(self, msg: Message) -> None:
+        pass  # async server has no clocks to drain
+
+
+class SyncServer(Server):
+    """BSP dispatcher preserving the reference SyncServer's observable
+    contract with per-worker vector clocks and deferred request caches."""
+
+    def __init__(self, num_workers: int) -> None:
+        super().__init__(num_workers)
+        # per-table clocks: table_id -> [adds applied per worker], [gets served per worker]
+        self._add_clock: Dict[int, List[int]] = {}
+        self._get_clock: Dict[int, List[int]] = {}
+        self._finished: List[bool] = [False] * num_workers
+        self._pending_add: Dict[int, List[Message]] = {}
+        self._pending_get: Dict[int, List[Message]] = {}
+
+    def register_table(self, server_table) -> int:
+        table_id = super().register_table(server_table)
+        self._add_clock[table_id] = [0] * self.num_workers
+        self._get_clock[table_id] = [0] * self.num_workers
+        self._pending_add[table_id] = []
+        self._pending_get[table_id] = []
+        return table_id
+
+    # clock helpers: finished workers never hold anyone back
+    def _min_gets(self, table_id: int) -> int:
+        vals = [g for g, f in zip(self._get_clock[table_id], self._finished) if not f]
+        return min(vals) if vals else 1 << 60
+
+    def _min_adds(self, table_id: int) -> int:
+        vals = [a for a, f in zip(self._add_clock[table_id], self._finished) if not f]
+        return min(vals) if vals else 1 << 60
+
+    def _process_add(self, msg: Message) -> None:
+        tid = msg.table_id
+        worker = msg.src
+        round_ = self._add_clock[tid][worker] + 1
+        # round-r Adds wait until every worker has finished its round-(r-1) Gets
+        if self._min_gets(tid) >= round_ - 1:
+            request, completion = msg.data
+            self._tables[tid].process_add(request)
+            self._add_clock[tid][worker] = round_
+            completion.done(None)
+            self._drain(tid)
+        else:
+            self._pending_add[tid].append(msg)
+
+    def _process_get(self, msg: Message) -> None:
+        tid = msg.table_id
+        worker = msg.src
+        round_ = self._get_clock[tid][worker] + 1
+        # round-i Gets wait until every worker's round-i Add is applied
+        if self._min_adds(tid) >= round_:
+            request, completion = msg.data
+            result = self._tables[tid].process_get(request)
+            self._get_clock[tid][worker] = round_
+            completion.done(result)
+            self._drain(tid)
+        else:
+            self._pending_get[tid].append(msg)
+
+    def _process_finish_train(self, msg: Message) -> None:
+        self._finished[msg.src] = True
+        for tid in list(self._tables):
+            self._drain(tid)
+
+    def _drain(self, table_id: int) -> None:
+        """Release deferred messages whose clock condition now holds."""
+        progressed = True
+        while progressed:
+            progressed = False
+            # gets first (they unblock next-round adds)
+            still: List[Message] = []
+            for msg in self._pending_get[table_id]:
+                worker = msg.src
+                round_ = self._get_clock[table_id][worker] + 1
+                if self._min_adds(table_id) >= round_:
+                    request, completion = msg.data
+                    result = self._tables[table_id].process_get(request)
+                    self._get_clock[table_id][worker] = round_
+                    completion.done(result)
+                    progressed = True
+                else:
+                    still.append(msg)
+            self._pending_get[table_id] = still
+            still = []
+            for msg in self._pending_add[table_id]:
+                worker = msg.src
+                round_ = self._add_clock[table_id][worker] + 1
+                if self._min_gets(table_id) >= round_ - 1:
+                    request, completion = msg.data
+                    self._tables[table_id].process_add(request)
+                    self._add_clock[table_id][worker] = round_
+                    completion.done(None)
+                    progressed = True
+                else:
+                    still.append(msg)
+            self._pending_add[table_id] = still
+
+
+def make_server(num_workers: int) -> Server:
+    """Factory keyed on the ``sync`` flag (reference: ``Server::GetServer``)."""
+    if config.get_flag("sync"):
+        return SyncServer(num_workers)
+    return Server(num_workers)

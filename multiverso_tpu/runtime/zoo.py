@@ -1,0 +1,214 @@
+"""Zoo — the runtime orchestrator (init, roles, barrier, table registry).
+
+Reference capability (not copied): a singleton that owns the actor registry
+and node table, starts/stops the system, implements the register protocol and
+barrier (``include/multiverso/zoo.h:19-85``, ``src/zoo.cpp``). Rank-0 ran a
+Controller actor assigning worker/server ids and broadcasting membership
+(``src/controller.cpp:38-80``).
+
+TPU-native re-design: on an SPMD substrate membership is static and known at
+init (JAX process index/count + the device mesh), so the register protocol
+degenerates to arithmetic — the Controller actor is subsumed by
+:meth:`Zoo._assign_ids`, and the barrier maps to a host-thread barrier within
+the process plus ``multihost_utils.sync_global_devices`` across processes.
+The *logical worker* concept is kept first-class: the reference scaled
+workers by adding MPI ranks; here a process hosts ``local_workers`` worker
+contexts (threads) and multi-process deployments multiply that by
+``jax.process_count()``. Server "ranks" are device shards of the table mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from multiverso_tpu import config, log
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.runtime.node import Node, Role
+from multiverso_tpu.runtime.server import Server, make_server
+
+config.define_int("local_workers", 1, "logical worker contexts hosted by this process")
+
+_thread_local = threading.local()
+
+
+class Zoo:
+    """Process-wide runtime singleton."""
+
+    _instance: Optional["Zoo"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._started = False
+        self.node = Node()
+        self.mesh: Optional[jax.sharding.Mesh] = None
+        self.server: Optional[Server] = None
+        self._local_workers = 1
+        self._process_index = 0
+        self._process_count = 1
+        self._barrier: Optional[threading.Barrier] = None
+        self._worker_tables: List[Any] = []
+        self._agg_lock = threading.Lock()
+        self._agg_slots: Dict[int, np.ndarray] = {}
+        self._agg_result: Optional[np.ndarray] = None
+
+    # -- singleton ---------------------------------------------------------
+    @classmethod
+    def instance(cls) -> "Zoo":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = Zoo()
+            return cls._instance
+
+    @classmethod
+    def _reset_instance(cls) -> None:
+        with cls._instance_lock:
+            cls._instance = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, argv: Optional[Sequence[str]] = None) -> List[str]:
+        if self._started:
+            log.fatal("Zoo.start called twice without stop")
+        remaining = config.parse_cmd_flags(list(argv) if argv else [])
+        self._process_index = jax.process_index()
+        self._process_count = jax.process_count()
+        self.node.rank = self._process_index
+        self.node.role = Role.from_string(config.get_flag("ps_role"))
+        self._local_workers = max(1, config.get_flag("local_workers"))
+        self._assign_ids()
+
+        shape = mesh_lib.parse_mesh_shape(config.get_flag("mesh_shape"))
+        axes = tuple(a for a in config.get_flag("mesh_axes").split(",") if a)
+        self.mesh = mesh_lib.build_mesh(shape=shape, axis_names=axes or ("server",))
+
+        self._barrier = threading.Barrier(self._local_workers)
+        if not config.get_flag("ma"):
+            # model-averaging mode skips the PS path entirely (reference:
+            # `-ma=true` skips StartPS)
+            self.server = make_server(self.num_workers)
+            self.server.start()
+        self._started = True
+        log.debug("Zoo started: rank=%d/%d workers=%d servers=%d mesh=%s",
+                  self.rank, self.size, self.num_workers, self.num_servers,
+                  self.mesh.shape)
+        self.process_barrier()
+        return remaining
+
+    def stop(self, finalize_net: bool = True) -> None:
+        if not self._started:
+            return
+        self.process_barrier()
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        self._worker_tables.clear()
+        self._started = False
+        if finalize_net:
+            Zoo._reset_instance()
+
+    def _assign_ids(self) -> None:
+        # Static membership: ids are pure arithmetic on (rank, role).
+        self.node.worker_id = (
+            self.rank * self._local_workers if self.node.is_worker else -1)
+        self.node.server_id = self.rank if self.node.is_server else -1
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def started(self) -> bool:
+        return self._started
+
+    @property
+    def rank(self) -> int:
+        return self._process_index
+
+    @property
+    def size(self) -> int:
+        return self._process_count
+
+    @property
+    def num_workers(self) -> int:
+        return self._process_count * self._local_workers
+
+    @property
+    def num_servers(self) -> int:
+        """Server shards = devices of the table mesh."""
+        return self.mesh.devices.size if self.mesh is not None else 0
+
+    @property
+    def local_workers(self) -> int:
+        return self._local_workers
+
+    def current_worker_id(self) -> int:
+        """Global worker id of the calling thread's worker context."""
+        local = getattr(_thread_local, "worker_slot", 0)
+        return self.rank * self._local_workers + local
+
+    def bind_worker(self, local_slot: int) -> None:
+        if not 0 <= local_slot < self._local_workers:
+            log.fatal("bind_worker: slot %d out of range [0,%d)", local_slot,
+                      self._local_workers)
+        _thread_local.worker_slot = local_slot
+
+    def worker_id_to_rank(self, worker_id: int) -> int:
+        return worker_id // self._local_workers
+
+    def server_id_to_rank(self, server_id: int) -> int:
+        return server_id
+
+    # -- barrier -----------------------------------------------------------
+    def barrier(self) -> None:
+        """Blocks until every worker context (all processes) arrives. Must be
+        called from every local worker context when ``local_workers > 1``."""
+        if self._barrier is not None and self._local_workers > 1:
+            self._barrier.wait()
+        if self._process_count > 1:
+            local = getattr(_thread_local, "worker_slot", 0)
+            if local == 0:
+                self.process_barrier()
+            if self._barrier is not None and self._local_workers > 1:
+                self._barrier.wait()
+
+    def process_barrier(self) -> None:
+        """Cross-process sync only (one caller per process) — used by
+        lifecycle code paths that run once per process, not per worker."""
+        if self._process_count > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mv_barrier")
+
+    # -- tables ------------------------------------------------------------
+    def register_table(self, worker_table: Any, server_table: Any) -> int:
+        if self.server is None:
+            log.fatal("register_table: PS disabled (ma mode) or Zoo not started")
+        table_id = self.server.register_table(server_table)
+        self._worker_tables.append(worker_table)
+        return table_id
+
+    # -- aggregate (model averaging) ----------------------------------------
+    def aggregate(self, data: np.ndarray) -> np.ndarray:
+        """In-place-sum semantics of ``MV_Aggregate``: returns the elementwise
+        sum of `data` across every worker (all processes × local workers)."""
+        data = np.asarray(data)
+        slot = self.current_worker_id()
+        with self._agg_lock:
+            self._agg_slots[slot] = data
+        if self._barrier is not None and self._local_workers > 1:
+            self._barrier.wait()
+        local = getattr(_thread_local, "worker_slot", 0)
+        if local == 0:
+            with self._agg_lock:
+                total = np.sum(list(self._agg_slots.values()), axis=0)
+                self._agg_slots.clear()
+            if self._process_count > 1:
+                from jax.experimental import multihost_utils
+                gathered = multihost_utils.process_allgather(total)
+                total = np.sum(gathered, axis=0)
+            self._agg_result = total
+        if self._barrier is not None and self._local_workers > 1:
+            self._barrier.wait()
+        result = self._agg_result
+        if self._barrier is not None and self._local_workers > 1:
+            self._barrier.wait()
+        return np.array(result, copy=True)

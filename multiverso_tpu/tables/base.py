@@ -1,0 +1,142 @@
+"""Table layer contracts: WorkerTable (client proxy) and ServerTable (state).
+
+Reference capability (not copied): ``WorkerTable`` client bookkeeping —
+per-request waiter with expected-reply count, msg-id allocation, sync
+wrappers ``Get/Add = Wait(XxxAsync(...))`` — and the abstract
+``ServerTable::ProcessAdd/ProcessGet`` + ``Serializable::Store/Load``
+checkpoint hooks (``include/multiverso/table_interface.h:24-75``,
+``src/table.cpp``), with ``table_factory::CreateTable`` wiring the pair
+(``include/multiverso/table_factory.h:16-26``).
+
+TPU-native re-design: there is no Partition step on the client — sharding is
+the server state's ``NamedSharding`` and XLA owns the partitioning. The async
+handle (msg_id → Completion) and the sync-wrapper shape are preserved so
+callers written against the reference's API port 1:1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from multiverso_tpu import log
+from multiverso_tpu.dashboard import monitor
+from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
+from multiverso_tpu.runtime.zoo import Zoo
+from multiverso_tpu.utils import Waiter
+
+
+class Completion:
+    """One outstanding request: a waiter plus its result slot."""
+
+    __slots__ = ("_waiter", "result", "error")
+
+    def __init__(self) -> None:
+        self._waiter = Waiter(1)
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def done(self, result: Any) -> None:
+        self.result = result
+        self._waiter.notify()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self._waiter.notify()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self._waiter.wait(timeout):
+            raise TimeoutError("table request timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class WorkerTable:
+    """Client proxy: issues Get/Add messages, tracks outstanding replies."""
+
+    def __init__(self) -> None:
+        self.table_id: int = -1
+        self._zoo = Zoo.instance()
+        self._pending: Dict[int, Completion] = {}
+        self._pending_request: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+
+    # -- wiring ------------------------------------------------------------
+    def _register(self, server_table: "ServerTable") -> None:
+        self.table_id = self._zoo.register_table(self, server_table)
+        server_table.table_id = self.table_id
+
+    # -- async machinery ---------------------------------------------------
+    def _submit(self, msg_type: MsgType, request: Any) -> int:
+        msg_id = next_msg_id()
+        completion = Completion()
+        with self._lock:
+            self._pending[msg_id] = completion
+            self._pending_request[msg_id] = request
+        msg = Message(src=self._zoo.current_worker_id(), dst=-1, type=msg_type,
+                      table_id=self.table_id, msg_id=msg_id,
+                      data=[request, completion])
+        self._zoo.server.send(msg)
+        return msg_id
+
+    def get_async(self, request: Any) -> int:
+        return self._submit(MsgType.Request_Get, request)
+
+    def add_async(self, request: Any) -> int:
+        return self._submit(MsgType.Request_Add, request)
+
+    def wait(self, msg_id: int) -> Any:
+        with self._lock:
+            completion = self._pending.pop(msg_id, None)
+            request = self._pending_request.pop(msg_id, None)
+        if completion is None:
+            log.fatal("wait: unknown msg_id %d on table %d", msg_id, self.table_id)
+        raw = completion.wait()
+        if raw is None:
+            return None
+        return self.process_reply_get(raw, request)
+
+    def process_reply_get(self, raw: Any, request: Any) -> Any:
+        """Post-process a Get reply (reference: ``ProcessReplyGet`` writes
+        into user buffers). Default: identity."""
+        return raw
+
+    # -- sync wrappers (Get/Add = Wait(Async)) ------------------------------
+    # NOTE: these call _submit directly (not self.get_async) so subclasses can
+    # override the async methods with their own signatures safely.
+    def get(self, request: Any) -> Any:
+        with monitor("WORKER_TABLE_SYNC_GET"):
+            return self.wait(self._submit(MsgType.Request_Get, request))
+
+    def add(self, request: Any) -> Any:
+        with monitor("WORKER_TABLE_SYNC_ADD"):
+            return self.wait(self._submit(MsgType.Request_Add, request))
+
+    def finish_train(self) -> None:
+        """Signal end-of-training so BSP clocks release peers
+        (reference: ``Server_Finish_Train``)."""
+        msg = Message(src=self._zoo.current_worker_id(), dst=-1,
+                      type=MsgType.Server_Finish_Train,
+                      table_id=self.table_id, msg_id=next_msg_id())
+        self._zoo.server.send(msg)
+
+
+class ServerTable:
+    """Device-resident table shard set + checkpoint hooks."""
+
+    def __init__(self) -> None:
+        self.table_id: int = -1
+
+    def process_add(self, request: Any) -> None:
+        raise NotImplementedError
+
+    def process_get(self, request: Any) -> Any:
+        raise NotImplementedError
+
+    # Serializable (checkpoint) hooks
+    def store(self, stream) -> None:
+        raise NotImplementedError
+
+    def load(self, stream) -> None:
+        raise NotImplementedError

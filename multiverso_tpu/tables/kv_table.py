@@ -1,0 +1,104 @@
+"""KVTable — distributed key-value table with arbitrary integer keys.
+
+Reference capability (not copied): header-only distributed
+``unordered_map<Key,Val>`` hash-sharded ``key % num_servers``, with a
+worker-side local cache ``raw()`` (``include/multiverso/table/kv_table.h``);
+its ``Store/Load`` were Fatal stubs — implemented for real here.
+
+TPU-native design note: in the reference this table holds *control-plane*
+state (e.g. word counts) on host RAM. The rebuild keeps that contract —
+host-side store behind the dispatcher thread (so the consistency modes apply
+uniformly) — while the *data-plane* sparse use case (huge embedding /
+topic-count matrices keyed by token id) belongs to the row-sharded
+MatrixTable / embedding ops, which keep values in HBM. A device-resident
+static-capacity hash table is tracked as a follow-up in ops/.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterable, Optional, Tuple, Union
+
+import numpy as np
+
+from multiverso_tpu.tables.base import ServerTable, WorkerTable
+
+
+class KVServer(ServerTable):
+    def __init__(self, value_dtype: Any = np.float32) -> None:
+        super().__init__()
+        self.value_dtype = np.dtype(value_dtype)
+        self._store: Dict[int, Any] = {}
+
+    def process_add(self, request) -> None:
+        keys, values, _option = request
+        for k, v in zip(keys, values):
+            if k in self._store:
+                self._store[k] = self._store[k] + v
+            else:
+                self._store[k] = v
+
+    def process_get(self, request):
+        keys, _option = request
+        if keys is None:
+            return dict(self._store)
+        zero = self.value_dtype.type(0)
+        return [self._store.get(k, zero) for k in keys]
+
+    def store(self, stream) -> None:
+        items = sorted(self._store.items())
+        stream.write(struct.pack("<q", len(items)))
+        for k, v in items:
+            arr = np.asarray(v, dtype=self.value_dtype)
+            stream.write(struct.pack("<q", int(k)))
+            stream.write(arr.tobytes() or self.value_dtype.type(0).tobytes())
+
+    def load(self, stream) -> None:
+        (count,) = struct.unpack("<q", stream.read(8))
+        self._store.clear()
+        item = self.value_dtype.itemsize
+        for _ in range(count):
+            (k,) = struct.unpack("<q", stream.read(8))
+            v = np.frombuffer(stream.read(item), dtype=self.value_dtype)[0]
+            self._store[k] = v
+
+
+class KVWorker(WorkerTable):
+    """Client proxy with a local cache (reference: ``raw()``)."""
+
+    def __init__(self, value_dtype: Any = np.float32,
+                 server: Optional[KVServer] = None) -> None:
+        super().__init__()
+        self.value_dtype = np.dtype(value_dtype)
+        self._server_table = server or KVServer(value_dtype)
+        self._register(self._server_table)
+        self._raw: Dict[int, Any] = {}
+
+    def raw(self) -> Dict[int, Any]:
+        return self._raw
+
+    def get(self, keys: Union[int, Iterable[int], None] = None):
+        single = isinstance(keys, (int, np.integer))
+        norm = None if keys is None else ([int(keys)] if single else [int(k) for k in keys])
+        result = super().get((norm, None))
+        if norm is None:
+            self._raw = result
+            return dict(result)
+        for k, v in zip(norm, result):
+            self._raw[k] = v
+        return result[0] if single else result
+
+    def add(self, keys: Union[int, Iterable[int]], values) -> None:
+        norm, vals = self._normalize(keys, values)
+        super().add((norm, vals, None))
+
+    def add_async(self, keys, values) -> int:
+        norm, vals = self._normalize(keys, values)
+        return super().add_async((norm, vals, None))
+
+    def _normalize(self, keys, values) -> Tuple[list, list]:
+        if isinstance(keys, (int, np.integer)):
+            return [int(keys)], [self.value_dtype.type(values)]
+        norm = [int(k) for k in keys]
+        vals = [self.value_dtype.type(v) for v in values]
+        return norm, vals

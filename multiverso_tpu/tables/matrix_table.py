@@ -1,0 +1,300 @@
+"""MatrixTable — 2-D dense distributed table with row-subset Get/Add and
+sparse staleness tracking.
+
+Reference capability (not copied): row-range-sharded dense matrix with
+per-row or whole-table Get/Add (``src/table/matrix_table.cpp``), the gen-2
+unified table with ``is_sparse`` per-worker × per-row ``up_to_date_``
+staleness tracking so sparse Gets return only stale rows
+(``src/table/matrix.cpp:517-572``), and the SparseMatrixTable wire
+compression variant (``src/table/sparse_matrix_table.cpp``).
+
+TPU-native re-design:
+
+* Server state is ONE row-sharded ``jax.Array`` in HBM; row Get is a jitted
+  device gather, row Add is a jitted scatter-add (linear updaters) or
+  gather→apply→scatter (stateful updaters) — the client-side per-server
+  ``Partition`` bucketing loop is gone, XLA partitions the scatter.
+* Row-id batches are padded to power-of-two buckets aimed at a sentinel
+  scratch row, so jit traces are reused across batch sizes and the MXU sees
+  static shapes.
+* ``up_to_date`` staleness tracking is host-side metadata (numpy bools):
+  it gates *what crosses the host boundary*, which is exactly the resource it
+  existed to save; wire compression (SparseFilter) only ever mattered on a
+  host hop and lives in ``multiverso_tpu.utils.quantization``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu import log
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.runtime.zoo import Zoo
+from multiverso_tpu.tables.base import ServerTable, WorkerTable
+from multiverso_tpu.tables.array_table import _make_whole_update
+from multiverso_tpu.updaters import AddOption, GetOption, SGDUpdater, Updater, get_updater
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class MatrixServer(ServerTable):
+    def __init__(self, num_row: int, num_col: int, dtype: Any = np.float32,
+                 updater_type: str = "", num_workers: Optional[int] = None,
+                 init_value: Optional[np.ndarray] = None,
+                 init_range: Optional[Tuple[float, float]] = None,
+                 seed: int = 0, is_sparse: bool = False) -> None:
+        super().__init__()
+        zoo = Zoo.instance()
+        self.num_row = int(num_row)
+        self.num_col = int(num_col)
+        self.dtype = np.dtype(dtype)
+        self.mesh = zoo.mesh
+        self.num_workers = num_workers if num_workers is not None else zoo.num_workers
+        num_shards = zoo.num_servers
+        # Keep >=1 scratch row past num_row: padded id buckets aim there.
+        self.padded_rows = mesh_lib.pad_to_multiple(self.num_row, num_shards)
+        if self.padded_rows == self.num_row:
+            self.padded_rows += num_shards
+        self.sentinel_row = self.num_row
+
+        sharding = mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0)
+        if init_value is not None:
+            init = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
+            init[: self.num_row] = np.asarray(init_value, dtype=self.dtype).reshape(
+                self.num_row, self.num_col)
+        elif init_range is not None:
+            # random-init server ctor overload (reference: matrix_table.cpp:372-384)
+            lo, hi = init_range
+            rng = np.random.default_rng(seed)
+            init = rng.uniform(lo, hi, size=(self.padded_rows, self.num_col)).astype(self.dtype)
+            init[self.num_row:] = 0
+        else:
+            init = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
+        self.data = jax.device_put(init, sharding)
+
+        self.updater = get_updater(self.dtype, updater_type)
+        worker_dim = self.num_workers if self.updater.per_worker_state else 1
+        self.states: Dict[str, jax.Array] = {}
+        for name, (shape_suffix, sdtype) in self.updater.state_spec(
+                (self.padded_rows, self.num_col), self.dtype).items():
+            s_shard = mesh_lib.table_sharding(self.mesh, ndim=3, shard_dim=1)
+            self.states[name] = jax.device_put(
+                np.zeros((worker_dim,) + tuple(shape_suffix), dtype=sdtype), s_shard)
+
+        # staleness metadata (gen-2 `up_to_date_`): host-side control plane
+        self.is_sparse = bool(is_sparse)
+        if self.is_sparse:
+            self._up_to_date = np.zeros((self.num_workers, self.num_row), dtype=bool)
+            self._std_lock = threading.Lock()
+
+        self._whole_update = _make_whole_update(self.updater)
+        self._linear = type(self.updater) in (Updater, SGDUpdater)
+        self._sign = -1.0 if isinstance(self.updater, SGDUpdater) else 1.0
+        self._gather = jax.jit(lambda data, ids: data[ids])
+        self._scatter_add = jax.jit(
+            lambda data, ids, delta: data.at[ids].add(delta), donate_argnums=(0,))
+        self._row_update = self._make_row_update(self.updater)
+
+    def _make_row_update(self, updater: Updater):
+        def f(data, states, ids, delta, worker, scalars):
+            rows = data[ids]
+            if updater.per_worker_state:
+                sliced = {k: v[worker, ids] for k, v in states.items()}
+            else:
+                sliced = {k: v[0, ids] for k, v in states.items()}
+            new_rows, new_sliced = updater.apply(rows, sliced, delta, scalars)
+            data = data.at[ids].set(new_rows)
+            if updater.per_worker_state:
+                new_states = {k: states[k].at[worker, ids].set(new_sliced[k]) for k in states}
+            else:
+                new_states = {k: states[k].at[0, ids].set(new_sliced[k]) for k in states}
+            return data, new_states
+
+        return jax.jit(f, donate_argnums=(0, 1))
+
+    # -- helpers -----------------------------------------------------------
+    def _bucket_ids(self, ids: np.ndarray,
+                    values: Optional[np.ndarray]) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], int]:
+        """Pad (ids, values) to a power-of-two bucket aimed at the sentinel
+        scratch row so jit traces are shape-stable."""
+        n = len(ids)
+        bucket = _next_pow2(n)
+        pad = bucket - n
+        ids_p = np.concatenate([ids, np.full(pad, self.sentinel_row, dtype=ids.dtype)])
+        vals_p = None
+        if values is not None:
+            vals_p = np.concatenate(
+                [values, np.zeros((pad, self.num_col), dtype=values.dtype)], axis=0)
+            vals_p = jnp.asarray(vals_p)
+        return jnp.asarray(ids_p), vals_p, n
+
+    # -- server ops --------------------------------------------------------
+    def process_add(self, request) -> None:
+        row_ids, values, option = request
+        option = option or AddOption()
+        scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
+        worker = jnp.int32(option.worker_id % max(1, self.num_workers))
+        if row_ids is None:
+            delta = np.asarray(values, dtype=self.dtype).reshape(self.num_row, self.num_col)
+            if self.padded_rows != self.num_row:
+                delta = np.pad(delta, ((0, self.padded_rows - self.num_row), (0, 0)))
+            self.data, self.states = self._whole_update(
+                self.data, self.states, jnp.asarray(delta), worker, scalars)
+            touched: Optional[np.ndarray] = None
+        else:
+            row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+            values = np.asarray(values, dtype=self.dtype).reshape(-1, self.num_col)
+            if len(row_ids) != len(values):
+                log.fatal("Matrix.add: %d ids but %d value rows", len(row_ids), len(values))
+            if not self._linear:
+                # stateful updaters need unique ids: pre-aggregate duplicates
+                row_ids, inv = np.unique(row_ids, return_inverse=True)
+                agg = np.zeros((len(row_ids), self.num_col), dtype=values.dtype)
+                np.add.at(agg, inv, values)
+                values = agg
+            ids_p, vals_p, _ = self._bucket_ids(row_ids, values)
+            if self._linear:
+                self.data = self._scatter_add(self.data, ids_p, self._sign * vals_p)
+            else:
+                self.data, self.states = self._row_update(
+                    self.data, self.states, ids_p, vals_p, worker, scalars)
+            touched = row_ids
+        if self.is_sparse:
+            with self._std_lock:
+                if touched is None:
+                    self._up_to_date[:, :] = False
+                else:
+                    self._up_to_date[:, touched] = False
+
+    def process_get(self, request):
+        row_ids, option = request
+        if row_ids is None:
+            if self.is_sparse and option is not None:
+                return self._sparse_get(option)
+            out = self.updater.access(self.data)
+            return np.asarray(jax.device_get(out))[: self.num_row]
+        row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+        ids_p, _, n = self._bucket_ids(row_ids, None)
+        rows = np.asarray(jax.device_get(self._gather(self.data, ids_p)))[:n]
+        if self.is_sparse and option is not None:
+            with self._std_lock:
+                self._up_to_date[option.worker_id % self.num_workers, row_ids] = True
+        return rows
+
+    def _sparse_get(self, option: GetOption):
+        """Return only the rows stale for this worker: (ids, rows)."""
+        w = option.worker_id % self.num_workers
+        with self._std_lock:
+            stale = np.where(~self._up_to_date[w])[0].astype(np.int32)
+            self._up_to_date[w, stale] = True
+        if len(stale) == 0:
+            return stale, np.zeros((0, self.num_col), dtype=self.dtype)
+        if len(stale) == self.num_row:
+            return stale, np.asarray(jax.device_get(self.data))[: self.num_row]
+        ids_p, _, n = self._bucket_ids(stale, None)
+        rows = np.asarray(jax.device_get(self._gather(self.data, ids_p)))[:n]
+        return stale, rows
+
+    # -- checkpoint --------------------------------------------------------
+    def store(self, stream) -> None:
+        from multiverso_tpu.checkpoint import write_array
+        write_array(stream, np.asarray(jax.device_get(self.data))[: self.num_row])
+
+    def load(self, stream) -> None:
+        from multiverso_tpu.checkpoint import read_array
+        arr = read_array(stream).astype(self.dtype).reshape(self.num_row, self.num_col)
+        padded = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
+        padded[: self.num_row] = arr
+        self.data = jax.device_put(
+            padded, mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0))
+
+
+class MatrixWorker(WorkerTable):
+    """Client proxy for a 2-D table: whole or row-subset Get/Add; in sparse
+    mode keeps a local row cache refreshed with only-stale-rows Gets."""
+
+    def __init__(self, num_row: int, num_col: int, dtype: Any = np.float32,
+                 updater_type: str = "", init_value: Optional[np.ndarray] = None,
+                 init_range: Optional[Tuple[float, float]] = None,
+                 is_sparse: bool = False, seed: int = 0,
+                 server: Optional[MatrixServer] = None) -> None:
+        super().__init__()
+        self.num_row = int(num_row)
+        self.num_col = int(num_col)
+        self.dtype = np.dtype(dtype)
+        self.is_sparse = bool(is_sparse)
+        self._server_table = server or MatrixServer(
+            num_row, num_col, dtype, updater_type, init_value=init_value,
+            init_range=init_range, seed=seed, is_sparse=is_sparse)
+        self._register(self._server_table)
+        self._cache: Optional[np.ndarray] = None
+        if self.is_sparse:
+            self._cache = np.zeros((self.num_row, self.num_col), dtype=self.dtype)
+
+    # -- get ---------------------------------------------------------------
+    def get(self, row_ids: Optional[np.ndarray] = None,
+            option: Optional[GetOption] = None) -> np.ndarray:
+        option = self._default_get_option(option)
+        raw = super().get((self._norm_ids(row_ids), option))
+        return self._finish_get(raw, row_ids)
+
+    def get_async(self, row_ids: Optional[np.ndarray] = None,
+                  option: Optional[GetOption] = None) -> int:
+        option = self._default_get_option(option)
+        return super().get_async((self._norm_ids(row_ids), option))
+
+    def process_reply_get(self, raw, request):
+        return raw
+
+    def wait_get(self, msg_id: int, row_ids: Optional[np.ndarray] = None) -> np.ndarray:
+        return self._finish_get(self.wait(msg_id), row_ids)
+
+    def _finish_get(self, raw, row_ids) -> np.ndarray:
+        if self.is_sparse and row_ids is None:
+            stale_ids, rows = raw
+            if len(stale_ids):
+                self._cache[stale_ids] = rows
+            return np.array(self._cache, copy=True)
+        return raw
+
+    # -- add ---------------------------------------------------------------
+    def add(self, values: np.ndarray, row_ids: Optional[np.ndarray] = None,
+            option: Optional[AddOption] = None) -> None:
+        option = self._default_add_option(option)
+        super().add((self._norm_ids(row_ids), values, option))
+
+    def add_async(self, values: np.ndarray, row_ids: Optional[np.ndarray] = None,
+                  option: Optional[AddOption] = None) -> int:
+        option = self._default_add_option(option)
+        return super().add_async((self._norm_ids(row_ids), values, option))
+
+    # -- helpers -----------------------------------------------------------
+    def _norm_ids(self, row_ids) -> Optional[np.ndarray]:
+        if row_ids is None:
+            return None
+        ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.num_row):
+            log.fatal("Matrix row id out of range [0, %d)", self.num_row)
+        return ids
+
+    def _default_add_option(self, option: Optional[AddOption]) -> AddOption:
+        if option is None:
+            option = AddOption()
+            option.worker_id = self._zoo.current_worker_id()
+        return option
+
+    def _default_get_option(self, option: Optional[GetOption]) -> GetOption:
+        if option is None:
+            option = GetOption(worker_id=self._zoo.current_worker_id())
+        return option
+
+    # -- TPU-era fast path -------------------------------------------------
+    def get_device(self) -> jax.Array:
+        return self._server_table.data

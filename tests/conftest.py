@@ -1,0 +1,60 @@
+"""Test harness: simulate a multi-chip mesh with 8 virtual CPU devices.
+
+This replaces the reference's ``mpirun -np N`` harness (SURVEY §4): tier-a
+pure-logic tests need no devices, tier-b "world of 1" tests run the full
+worker→dispatcher→table path in-process, tier-c multi-shard tests run on the
+8-device virtual mesh.
+"""
+
+import os
+
+# Must be set before jax initializes its backends. Force CPU even when the
+# ambient environment points at a TPU platform: tests simulate a multi-chip
+# mesh with 8 virtual CPU devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The ambient sitecustomize pins jax_platforms to the TPU plugin; override
+# via config (env alone is not enough once the plugin registered).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import multiverso_tpu as mv  # noqa: E402
+from multiverso_tpu.config import FLAGS  # noqa: E402
+from multiverso_tpu.dashboard import Dashboard  # noqa: E402
+from multiverso_tpu.runtime.zoo import Zoo  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_runtime():
+    """Reference's MultiversoEnv fixture: fresh flags + runtime per test."""
+    FLAGS.reset()
+    Dashboard.reset()
+    yield
+    try:
+        if Zoo.instance().started:
+            mv.shutdown()
+    finally:
+        Zoo._reset_instance()
+        FLAGS.reset()
+
+
+@pytest.fixture
+def mv_env():
+    """World-of-1 environment: this process is worker 0 and all server shards."""
+    mv.init()
+    yield
+    mv.shutdown()
+
+
+@pytest.fixture
+def sync_env():
+    mv.init(sync=True)
+    yield
+    mv.shutdown()

@@ -1,0 +1,175 @@
+"""Tier-a pure-logic tests: flags, log, monitors, IO, queues (SURVEY §4.1)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu import config, log
+from multiverso_tpu.config import FlagRegistry, FlagError
+from multiverso_tpu.dashboard import Dashboard, Timer, monitor
+from multiverso_tpu.io import URI, MemoryStream, TextReader, get_stream
+from multiverso_tpu.utils import AsyncBuffer, MtQueue, Waiter
+
+
+# -- config ------------------------------------------------------------------
+
+def test_flag_defaults():
+    assert config.get_flag("sync") is False
+    assert config.get_flag("updater_type") == "default"
+    assert config.get_flag("omp_threads") == 4
+
+
+def test_parse_cmd_flags_compacts_argv():
+    reg = FlagRegistry()
+    reg.define_bool("sync", False)
+    reg.define_int("n", 1)
+    remaining = reg.parse_cmd_flags(["prog", "-sync=true", "--n=7", "-unknown=1", "pos"])
+    assert remaining == ["prog", "-unknown=1", "pos"]
+    assert reg.get("sync") is True
+    assert reg.get("n") == 7
+
+
+def test_set_flag_parses_strings():
+    reg = FlagRegistry()
+    reg.define_bool("b", False)
+    reg.define_double("d", 0.0)
+    reg.set("b", "true")
+    reg.set("d", "2.5")
+    assert reg.get("b") is True
+    assert reg.get("d") == 2.5
+    with pytest.raises(FlagError):
+        reg.get("missing")
+
+
+# -- log ---------------------------------------------------------------------
+
+def test_check_raises_fatal():
+    with pytest.raises(log.FatalError):
+        log.check(False, "boom")
+    log.check(True)
+    assert log.check_notnull(5) == 5
+    with pytest.raises(log.FatalError):
+        log.check_notnull(None)
+
+
+def test_log_file_sink(tmp_path):
+    path = str(tmp_path / "mv.log")
+    log.reset_log_file(path)
+    log.info("hello %d", 42)
+    log.reset_log_file("")
+    with open(path) as fp:
+        assert "hello 42" in fp.read()
+
+
+# -- dashboard ---------------------------------------------------------------
+
+def test_monitor_aggregates():
+    Dashboard.reset()
+    for _ in range(3):
+        with monitor("section"):
+            pass
+    mon = Dashboard.watch("section")
+    assert mon.count == 3
+    assert mon.elapse_ms >= 0
+    assert "section" in Dashboard.display()
+
+
+def test_timer():
+    t = Timer()
+    assert t.elapse_ms() >= 0
+
+
+# -- io ----------------------------------------------------------------------
+
+def test_uri_parse():
+    u = URI.parse("/tmp/x")
+    assert u.scheme == "file" and u.path == "/tmp/x"
+    u = URI.parse("file:///tmp/x")
+    assert u.scheme == "file" and u.path == "/tmp/x"
+    u = URI.parse("hdfs://host:9000/a/b")
+    assert u.scheme == "hdfs" and u.host == "host:9000" and u.path == "/a/b"
+
+
+def test_local_stream_roundtrip(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    with get_stream(path, "w") as s:
+        s.write(b"abc123")
+    with get_stream(path, "r") as s:
+        assert s.read() == b"abc123"
+
+
+def test_unknown_scheme_fatal():
+    with pytest.raises(log.FatalError):
+        get_stream("nosuch://x/y", "r")
+
+
+def test_text_reader(tmp_path):
+    path = str(tmp_path / "lines.txt")
+    with open(path, "w") as fp:
+        fp.write("one\ntwo\r\nthree")
+    reader = TextReader(path, buf_size=4)
+    assert [reader.get_line(), reader.get_line(), reader.get_line()] == [
+        "one", "two", "three"]
+    assert reader.get_line() is None
+
+
+def test_memory_stream():
+    s = MemoryStream()
+    s.write(b"xy")
+    s.seek(0)
+    assert s.read() == b"xy"
+
+
+# -- utils -------------------------------------------------------------------
+
+def test_mt_queue_fifo_and_exit():
+    q: MtQueue[int] = MtQueue()
+    q.push(1)
+    q.push(2)
+    assert q.front() == 1
+    assert q.pop() == 1
+    assert q.try_pop() == 2
+    assert q.try_pop() is None
+    q.exit()
+    assert q.pop() is None
+
+
+def test_mt_queue_blocking_pop():
+    q: MtQueue[int] = MtQueue()
+    out = []
+
+    def consumer():
+        out.append(q.pop())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    q.push(99)
+    t.join(timeout=5)
+    assert out == [99]
+
+
+def test_waiter_counts():
+    w = Waiter(2)
+    assert not w.wait(timeout=0.01)
+    w.notify()
+    w.notify()
+    assert w.wait(timeout=1)
+    w.reset(1)
+    assert not w.wait(timeout=0.01)
+    w.notify()
+    assert w.wait(timeout=1)
+
+
+def test_async_buffer_prefetches():
+    counter = {"n": 0}
+
+    def fill(buf):
+        counter["n"] += 1
+        buf[0] = counter["n"]
+
+    buf = AsyncBuffer([0], [0], fill)
+    first = buf.get()[0]
+    second = buf.get()[0]
+    buf.stop()
+    assert (first, second) == (1, 2)

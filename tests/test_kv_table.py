@@ -1,0 +1,48 @@
+"""KVTable tests (reference: Test/unittests/test_kv.cpp, test_kv_table.cpp)."""
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.io import MemoryStream
+
+
+def test_kv_add_get(mv_env):
+    table = mv.create_table("kv", np.float32)
+    table.add([0, 1, 2], [1.0, 2.0, 3.0])
+    assert table.get([0, 1, 2]) == [1.0, 2.0, 3.0]
+    table.add([1], [10.0])
+    assert table.get(1) == 12.0
+    assert table.get(99) == 0.0  # missing key -> zero
+
+
+def test_kv_scalar_api(mv_env):
+    table = mv.create_table("kv", np.int64)
+    table.add(7, 5)
+    table.add(7, 5)
+    assert table.get(7) == 10
+
+
+def test_kv_local_cache(mv_env):
+    table = mv.create_table("kv", np.float32)
+    table.add([3, 4], [1.5, 2.5])
+    table.get([3, 4])
+    assert table.raw()[3] == 1.5 and table.raw()[4] == 2.5
+
+
+def test_kv_get_all(mv_env):
+    table = mv.create_table("kv", np.float32)
+    table.add([1, 2], [1.0, 2.0])
+    snapshot = table.get()
+    assert snapshot == {1: 1.0, 2: 2.0}
+
+
+def test_kv_store_load(mv_env):
+    """Reference Store/Load were Fatal stubs (kv_table.h:108-114); ours work."""
+    table = mv.create_table("kv", np.float32)
+    table.add([5, 9], [1.0, 4.0])
+    stream = MemoryStream()
+    table._server_table.store(stream)
+    table2 = mv.create_table("kv", np.float32)
+    stream.seek(0)
+    table2._server_table.load(stream)
+    assert table2.get([5, 9]) == [1.0, 4.0]

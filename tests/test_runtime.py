@@ -1,0 +1,93 @@
+"""Tier-a/b runtime tests: message taxonomy, roles, zoo bring-up, barrier,
+aggregate (reference: test_message.cpp, test_node.cpp, test_allreduce.cpp)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.runtime.node import Node, Role
+
+
+def test_msg_type_signs():
+    assert MsgType.Request_Get.is_server_bound
+    assert MsgType.Reply_Get.is_worker_bound
+    assert MsgType.Control_Barrier.is_control
+    assert not MsgType.Request_Add.is_control
+
+
+def test_message_reply_inversion():
+    msg = Message(src=3, dst=7, type=MsgType.Request_Add, table_id=2, msg_id=9)
+    reply = msg.create_reply()
+    assert (reply.src, reply.dst) == (7, 3)
+    assert reply.type == MsgType.Reply_Add
+    assert reply.table_id == 2 and reply.msg_id == 9
+
+
+def test_role_bitmask():
+    assert Role.ALL == Role.WORKER | Role.SERVER
+    node = Node(role=Role.WORKER)
+    assert node.is_worker and not node.is_server
+    assert Role.from_string("default") == Role.ALL
+    with pytest.raises(ValueError):
+        Role.from_string("bogus")
+
+
+def test_zoo_world_of_one(mv_env):
+    assert mv.rank() == 0
+    assert mv.size() == 1
+    assert mv.num_workers() == 1
+    assert mv.num_servers() == 8  # 8 virtual devices = 8 server shards
+    assert mv.worker_id() == 0
+    assert mv.is_master_worker()
+    assert mv.worker_id_to_rank(0) == 0
+    assert mv.server_id_to_rank(0) == 0
+    mv.barrier()
+
+
+def test_local_workers_identity():
+    mv.init(local_workers=3)
+    assert mv.num_workers() == 3
+    ids = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            ids[slot] = mv.worker_id()
+            mv.barrier()
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert ids == {0: 0, 1: 1, 2: 2}
+    mv.shutdown()
+
+
+def test_aggregate_sums_across_workers():
+    """MV_Aggregate contract: result == elementwise sum over all workers
+    (reference Test/test_allreduce.cpp: ones -> MV_Size)."""
+    mv.init(ma=True, local_workers=4)
+    results = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            results[slot] = mv.aggregate(np.ones(5, dtype=np.float32))
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    for r in results.values():
+        np.testing.assert_array_equal(r, np.full(5, 4.0, dtype=np.float32))
+    mv.shutdown()
+
+
+def test_ma_mode_disables_tables():
+    mv.init(ma=True)
+    with pytest.raises(mv.log.FatalError):
+        mv.create_table("array", 10)
+    mv.shutdown()

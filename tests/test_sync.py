@@ -1,0 +1,93 @@
+"""BSP consistency tests (reference: Test/unittests/test_sync.cpp + the
+SyncServer contract in src/server.cpp:61-67): every worker's i-th Get
+observes exactly i rounds of every worker's Adds, and all workers' round-i
+Gets return identical values."""
+
+import threading
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+def _run_workers(n, fn):
+    threads = [threading.Thread(target=fn, args=(s,)) for s in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for t in threads:
+        assert not t.is_alive(), "worker thread hung (BSP deadlock?)"
+
+
+def test_sync_rounds_observe_all_workers():
+    workers = 4
+    rounds = 5
+    size = 8
+    mv.init(sync=True, local_workers=workers)
+    table = mv.create_table("array", size, np.float32)
+    results = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            out = []
+            for _ in range(rounds):
+                table.add(np.ones(size, np.float32))
+                out.append(table.get().copy())
+            results[slot] = out
+
+    _run_workers(workers, run)
+    for slot, outs in results.items():
+        for i, val in enumerate(outs):
+            np.testing.assert_allclose(
+                val, np.full(size, (i + 1) * workers, np.float32),
+                err_msg=f"worker {slot} round {i}")
+    mv.shutdown()
+
+
+def test_sync_get_identical_across_workers():
+    workers = 3
+    mv.init(sync=True, local_workers=workers)
+    table = mv.create_table("array", 4, np.float32)
+    seen = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            table.add(np.full(4, float(slot + 1), np.float32))
+            seen[slot] = table.get().copy()
+
+    _run_workers(workers, run)
+    expected = np.full(4, float(sum(range(1, workers + 1))), np.float32)
+    for slot in range(workers):
+        np.testing.assert_allclose(seen[slot], expected)
+    mv.shutdown()
+
+
+def test_finish_train_releases_peers():
+    """A finished worker must not block others' clocks
+    (reference: SyncServer::ProcessFinishTrain)."""
+    workers = 2
+    mv.init(sync=True, local_workers=workers)
+    table = mv.create_table("array", 4, np.float32)
+    done = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            rounds = 1 if slot == 0 else 3
+            for _ in range(rounds):
+                table.add(np.ones(4, np.float32))
+                table.get()
+            table.finish_train()
+            done[slot] = True
+
+    _run_workers(workers, run)
+    assert done == {0: True, 1: True}
+    mv.shutdown()
+
+
+def test_async_mode_no_round_blocking(mv_env):
+    """Async server: a single worker can run ahead freely."""
+    table = mv.create_table("array", 4, np.float32)
+    for _ in range(10):
+        table.add(np.ones(4, np.float32))
+    np.testing.assert_allclose(table.get(), np.full(4, 10.0))
