@@ -1,0 +1,168 @@
+"""Word2Vec model tests: vocab/Huffman structure, pair generation, and
+training effectiveness on synthetic corpora (both objectives, both modes,
+device and PS trainers)."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.vocab import (Dictionary, HuffmanEncoder,
+                                         iter_token_blocks)
+from multiverso_tpu.models.word2vec import (DeviceTrainer, PSTrainer,
+                                            Word2VecConfig, generate_cbow_batches,
+                                            generate_sg_pairs, init_params,
+                                            make_train_step)
+
+
+def make_dictionary(vocab=20):
+    # zipf-ish counts, already sorted desc
+    counts = np.maximum((1000 / np.arange(1, vocab + 1)).astype(np.int64), 5)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = counts
+    return d
+
+
+# -- vocab -------------------------------------------------------------------
+
+def test_dictionary_build_min_count_and_order():
+    toks = ["a"] * 10 + ["b"] * 5 + ["c"] * 2
+    d = Dictionary.build(toks, min_count=3)
+    assert d.words == ["a", "b"]
+    assert d.word2id == {"a": 0, "b": 1}
+    np.testing.assert_array_equal(d.counts, [10, 5])
+    np.testing.assert_array_equal(d.encode(["b", "c", "a"]), [1, 0])
+
+
+def test_unigram_cdf_monotone():
+    d = make_dictionary()
+    cdf = d.unigram_cdf()
+    assert np.all(np.diff(cdf) >= 0)
+    assert abs(cdf[-1] - 1.0) < 1e-5
+
+
+def test_huffman_codes_prefix_free_and_optimal_order():
+    d = make_dictionary(vocab=10)
+    enc = HuffmanEncoder(d.counts)
+    lens = enc.code_lengths
+    # frequent words get codes no longer than rare ones (Huffman property)
+    assert lens[0] <= lens[-1]
+    # prefix-free: no word's code is a prefix of another's
+    codes = ["".join(map(str, enc.codes[w, :lens[w]])) for w in range(10)]
+    for i, ci in enumerate(codes):
+        for j, cj in enumerate(codes):
+            if i != j:
+                assert not cj.startswith(ci)
+    # points index internal nodes: 0 <= p < vocab-1
+    for w in range(10):
+        pts = enc.points[w, :lens[w]]
+        assert (pts >= 0).all() and (pts < 9).all()
+
+
+def test_iter_token_blocks(tmp_path):
+    path = str(tmp_path / "corpus.txt")
+    with open(path, "w") as fp:
+        fp.write("a b a b\n" * 50)
+    d = Dictionary.from_text_file(path, min_count=1)
+    blocks = list(iter_token_blocks(path, d, block_tokens=64))
+    assert sum(len(b) for b in blocks) == 200
+    assert all(len(b) <= 64 for b in blocks[:-1])
+
+
+# -- pair generation ---------------------------------------------------------
+
+def test_sg_pairs_within_window():
+    rng = np.random.default_rng(0)
+    block = np.arange(50, dtype=np.int32)
+    centers, contexts = generate_sg_pairs(block, window=3, rng=rng)
+    assert len(centers) == len(contexts) > 0
+    assert (np.abs(centers - contexts) <= 3).all()
+    assert (np.abs(centers - contexts) >= 1).all()
+
+
+def test_cbow_batches_shape_and_padding():
+    rng = np.random.default_rng(0)
+    block = np.arange(20, dtype=np.int32)
+    centers, ctx = generate_cbow_batches(block, window=2, rng=rng)
+    assert ctx.shape == (len(centers), 4)
+    assert ((ctx >= -1) & (ctx < 20)).all()
+
+
+# -- training ----------------------------------------------------------------
+
+def _synthetic_corpus(rng, vocab=30, n=6000):
+    """Corpus where even ids co-occur with even, odd with odd — embeddings
+    must separate the two clusters."""
+    half = vocab // 2
+    blocks = []
+    for _ in range(n // 20):
+        parity = rng.integers(0, 2)
+        blocks.append(parity + 2 * rng.integers(0, half, size=20))
+    return np.concatenate(blocks).astype(np.int32)
+
+
+def _cluster_score(emb, vocab):
+    """Mean within-parity cosine sim minus cross-parity sim."""
+    norm = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+    sim = norm @ norm.T
+    even = np.arange(0, vocab, 2)
+    odd = np.arange(1, vocab, 2)
+    within = (sim[np.ix_(even, even)].mean() + sim[np.ix_(odd, odd)].mean()) / 2
+    cross = sim[np.ix_(even, odd)].mean()
+    return within - cross
+
+
+@pytest.mark.parametrize("mode,objective,lr,epochs",
+                         [("sg", "ns", 0.3, 10), ("cbow", "ns", 0.5, 20),
+                          ("sg", "hs", 0.3, 10)])
+def test_training_separates_clusters(mode, objective, lr, epochs):
+    vocab = 30
+    rng = np.random.default_rng(0)
+    corpus = _synthetic_corpus(rng, vocab)
+    counts = np.bincount(corpus, minlength=vocab).astype(np.int64)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(counts, 1)
+
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            mode=mode, objective=objective, lr=lr,
+                            batch_pairs=512, sample=0.0)
+    trainer = DeviceTrainer(config, d)
+    blocks = [corpus[i:i + 1000] for i in range(0, len(corpus), 1000)]
+    trainer.train(blocks, epochs=epochs)
+    score = _cluster_score(trainer.embeddings(), vocab)
+    assert score > 0.2, f"clusters not separated: {score}"
+
+
+def test_ps_trainer_matches_contract(mv_env):
+    """PS path trains through MatrixTable Get/Add and still learns."""
+    vocab = 20
+    rng = np.random.default_rng(1)
+    corpus = _synthetic_corpus(rng, vocab, n=4000)
+    counts = np.bincount(corpus, minlength=vocab).astype(np.int64)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(counts, 1)
+
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            lr=0.3, batch_pairs=512, sample=0.0)
+    trainer = PSTrainer(config, d)
+    for _ in range(10):
+        for i in range(0, len(corpus), 1000):
+            trainer.train_block(corpus[i:i + 1000])
+    score = _cluster_score(trainer.embeddings(), vocab)
+    assert score > 0.2, f"PS trainer failed to learn: {score}"
+    # word-count table tracked training volume
+    assert trainer.count_table.get(0) == trainer.words_trained
+
+
+def test_init_params_sharded_on_mesh(mv_env):
+    from multiverso_tpu.runtime.zoo import Zoo
+    mesh = Zoo.instance().mesh
+    config = Word2VecConfig(vocab_size=100, dim=8)
+    params = init_params(config, mesh)
+    assert params["w_in"].shape[0] % 8 == 0  # padded to 8 shards
+    assert not params["w_in"].sharding.is_fully_replicated
