@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line.
+
+Headline: word2vec skip-gram+NS training throughput (words/sec/chip) on the
+HBM-resident block-mode path — the BASELINE.md north-star metric
+("WordEmbedding words/sec/chip"). ``vs_baseline`` compares against 100k
+words/sec, the canonical per-thread rate of the reference's C hot loop
+(its only published form is the live "Words/thread/second: Xk" log,
+``Applications/WordEmbedding/src/trainer.cpp:44-48``; 100k/thread is the
+standard figure for word2vec-style CPU loops on one modern core).
+
+Extra fields: MatrixTable row Add/Get device-path p50 latency (BASELINE
+target < 50 µs) and effective scatter/gather bandwidth.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40,
+                   warmup=3):
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.models.vocab import Dictionary
+    from multiverso_tpu.models.word2vec import (Word2VecConfig, init_params,
+                                                make_block_train_step)
+
+    counts = np.maximum((1e7 / np.arange(1, vocab + 1)).astype(np.int64), 5)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {}
+    d.counts = counts
+    config = Word2VecConfig(vocab_size=vocab, dim=dim, window=5, negatives=5,
+                            block_tokens=block_tokens, sample=0.0)
+    params = init_params(config, mesh=None)
+    # scan-mode: ONE dispatch per n_blocks — measures the chip, not the
+    # host/tunnel round-trip
+    from multiverso_tpu.models.word2vec import make_corpus_train_step
+    step = make_corpus_train_step(config, d)
+
+    # zipf-ish synthetic corpus, sampled via inverse CDF
+    p = counts.astype(np.float64) / counts.sum()
+    cdf = np.cumsum(p)
+    rng = np.random.default_rng(0)
+    stack = np.searchsorted(
+        cdf, rng.random((n_blocks, block_tokens))).astype(np.int32)
+    stack_dev = jax.device_put(stack)
+
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    params, loss = step(params, sub, stack_dev[:warmup], config.lr)  # compile small
+    key, sub = jax.random.split(key)
+    params, loss = step(params, sub, stack_dev, config.lr)           # compile full
+    jax.block_until_ready(params["w_in"])
+
+    key, sub = jax.random.split(key)
+    t0 = time.perf_counter()
+    params, loss = step(params, sub, stack_dev, config.lr)
+    jax.block_until_ready(params["w_in"])
+    dt = time.perf_counter() - t0
+    words = n_blocks * block_tokens
+    return words / dt, float(loss)
+
+
+def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024, iters=50):
+    """Device-path row scatter-add / gather on a 1M×50 fp32 table (the
+    reference perf harness shape, Test/test_matrix_perf.cpp:32-45)."""
+    import jax
+    import jax.numpy as jnp
+
+    import jax.lax as lax
+
+    data = jnp.zeros((rows, cols), jnp.float32)
+    # chain `iters` ops inside one dispatch (lax.scan) so the per-op time
+    # reflects device latency, not the host/tunnel round-trip
+    n_id_sets = 8
+    rng = np.random.default_rng(0)
+    ids_stack = jax.device_put(
+        rng.integers(0, rows, (n_id_sets, batch_rows)).astype(np.int32))
+    vals = jax.device_put(np.ones((batch_rows, cols), np.float32))
+
+    @jax.jit
+    def add_chain(d):
+        def body(d, i):
+            return d.at[ids_stack[i % n_id_sets]].add(vals), 0.0
+        d, _ = lax.scan(body, d, jnp.arange(iters))
+        return d
+
+    @jax.jit
+    def get_chain(d):
+        def body(acc, i):
+            return acc + d[ids_stack[i % n_id_sets]].sum(), 0.0
+        acc, _ = lax.scan(body, 0.0, jnp.arange(iters))
+        return acc
+
+    data = add_chain(data)
+    jax.block_until_ready(data)        # compile
+    jax.block_until_ready(get_chain(data))
+
+    t0 = time.perf_counter()
+    data = add_chain(data)
+    jax.block_until_ready(data)
+    add_per_op = (time.perf_counter() - t0) / iters
+    t0 = time.perf_counter()
+    jax.block_until_ready(get_chain(data))
+    get_per_op = (time.perf_counter() - t0) / iters
+
+    bytes_moved = batch_rows * cols * 4
+    return {
+        "matrix_add_p50_us": round(add_per_op * 1e6, 1),
+        "matrix_get_p50_us": round(get_per_op * 1e6, 1),
+        "matrix_add_gbps": round(bytes_moved / add_per_op / 1e9, 2),
+        "matrix_get_gbps": round(bytes_moved / get_per_op / 1e9, 2),
+    }
+
+
+def main():
+    words_per_sec, final_loss = bench_word2vec()
+    matrix = bench_matrix_table()
+    result = {
+        "metric": "word2vec_words_per_sec_per_chip",
+        "value": round(words_per_sec, 1),
+        "unit": "words/s",
+        "vs_baseline": round(words_per_sec / 100_000.0, 2),
+        "final_loss": round(final_loss, 4),
+        **matrix,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

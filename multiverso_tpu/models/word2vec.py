@@ -35,6 +35,7 @@ import numpy as np
 
 from multiverso_tpu import log
 from multiverso_tpu.models.vocab import Dictionary, HuffmanEncoder
+from multiverso_tpu.ops.sampling import unigram_negative_sampler
 from multiverso_tpu.parallel import mesh as mesh_lib
 
 
@@ -47,7 +48,8 @@ class Word2VecConfig:
     mode: str = "sg"          # "sg" | "cbow"
     objective: str = "ns"     # "ns" | "hs"
     lr: float = 0.025
-    batch_pairs: int = 8192   # pairs per device step
+    batch_pairs: int = 8192   # pairs per device step (pair-mode trainers)
+    block_tokens: int = 8192  # tokens per device step (block-mode trainer)
     sample: float = 1e-3      # subsampling threshold
     max_code_length: int = 40
     seed: int = 1
@@ -64,13 +66,15 @@ def init_params(config: Word2VecConfig, mesh=None,
     rng = np.random.default_rng(config.seed)
 
     def make(rows: int, random_init: bool) -> np.ndarray:
+        true_rows = rows
+        rows += 1  # scratch sentinel row: masked pairs scatter here
         if mesh is not None:
             shards = mesh.devices.size if "model" not in mesh.shape else mesh.shape["model"]
             rows = mesh_lib.pad_to_multiple(rows, max(shards, pad_rows_to))
         arr = np.zeros((rows, config.dim), dtype=np.float32)
         if random_init:
-            arr[:] = rng.uniform(-0.5 / config.dim, 0.5 / config.dim,
-                                 size=(rows, config.dim))
+            arr[:true_rows] = rng.uniform(-0.5 / config.dim, 0.5 / config.dim,
+                                          size=(true_rows, config.dim))
         return arr
 
     w_in = make(v, random_init=True)
@@ -86,12 +90,12 @@ def init_params(config: Word2VecConfig, mesh=None,
 
 # -- the jitted step --------------------------------------------------------
 
-def _ns_targets(key: jax.Array, contexts: jax.Array, cdf: jax.Array,
+def _ns_targets(key: jax.Array, contexts: jax.Array, sampler,
                 negatives: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """(ids, labels, mask) for negative sampling: 1 positive + K sampled."""
+    """(ids, labels, mask) for negative sampling: 1 positive + K alias-sampled
+    (searchsorted binary search is ~50x slower on TPU — see ops/sampling)."""
     b = contexts.shape[0]
-    u = jax.random.uniform(key, (b, negatives))
-    negs = jnp.searchsorted(cdf, u).astype(jnp.int32)
+    negs = sampler(key, (b, negatives))
     ids = jnp.concatenate([contexts[:, None], negs], axis=1)        # (B, 1+K)
     labels = jnp.zeros_like(ids, dtype=jnp.float32).at[:, 0].set(1.0)
     mask = jnp.ones_like(labels)
@@ -150,14 +154,14 @@ def make_train_step(config: Word2VecConfig, dictionary: Dictionary,
            for cbow — dict(centers (B,), context_block (B, 2W) id or -1).
     """
     if config.objective == "ns":
-        cdf = jnp.asarray(dictionary.unigram_cdf())
+        sampler = unigram_negative_sampler(dictionary.counts)
         hs_arrays = None
     else:
         if huffman is None:
             huffman = HuffmanEncoder(dictionary.counts, config.max_code_length)
         hs_arrays = (jnp.asarray(huffman.codes), jnp.asarray(huffman.points),
                      jnp.asarray(huffman.mask()))
-        cdf = None
+        sampler = None
 
     def step(params, key, batch, lr):
         centers = batch["centers"]
@@ -172,7 +176,7 @@ def make_train_step(config: Word2VecConfig, dictionary: Dictionary,
             in_weights = valid / jnp.maximum(valid.sum(1, keepdims=True), 1.0)
             predict = centers
         if config.objective == "ns":
-            out_ids, labels, mask = _ns_targets(key, predict, cdf,
+            out_ids, labels, mask = _ns_targets(key, predict, sampler,
                                                 config.negatives)
         else:
             codes, points, code_mask = hs_arrays
@@ -181,6 +185,123 @@ def make_train_step(config: Word2VecConfig, dictionary: Dictionary,
                                        in_ids, in_weights, out_ids, labels,
                                        mask, lr)
         return {"w_in": w_in, "w_out": w_out}, loss
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
+                          jit: bool = True):
+    """Block-mode step: the host ships ONE int32 token block per step (pad
+    with -1); window pair extraction, dynamic-window masking, negative
+    sampling, and the update all happen in-jit. This minimizes host↔device
+    traffic (the TPU-era analog of the reference's block pipeline, which
+    existed to hide *network* latency; here it removes PCIe/host latency).
+
+    step(params, key, block (T,), lr) -> (params, loss). Skip-gram + NS.
+    Pass ``jit=False`` to get the raw traceable function (for scan wrappers).
+    """
+    if config.mode != "sg" or config.objective != "ns":
+        log.fatal("block step supports sg+ns (the benchmark path)")
+    sampler = unigram_negative_sampler(dictionary.counts)
+    window = config.window
+    negatives = config.negatives
+    offsets = np.array([o for o in range(-window, window + 1) if o != 0],
+                       dtype=np.int32)                               # (2W,)
+
+    def step(params, key, block, lr):
+        # Structured form: keep the (T, 2W) pair layout instead of a flat
+        # pair list. The input row of a center is gathered ONCE for its 2W
+        # pairs, negatives are shared per center, and gradients are
+        # pre-reduced over the window axis before scattering — ~10× less
+        # HBM gather/scatter traffic than the flat-pair formulation.
+        w_in, w_out = params["w_in"], params["w_out"]
+        sentinel_in = w_in.shape[0] - 1
+        sentinel_out = w_out.shape[0] - 1
+        t = block.shape[0]
+        k_win, k_neg = jax.random.split(key)
+        valid_tok = block >= 0
+        # dynamic window size per center position
+        b = jax.random.randint(k_win, (t,), 1, window + 1)           # (T,)
+        pos = jnp.arange(t)
+        ctx_pos = pos[:, None] + offsets[None, :]                    # (T, 2W)
+        in_range = (ctx_pos >= 0) & (ctx_pos < t)
+        ctx_pos = jnp.clip(ctx_pos, 0, t - 1)
+        contexts = block[ctx_pos]                                    # (T, 2W)
+        pair_mask = (in_range
+                     & (jnp.abs(offsets)[None, :] <= b[:, None])
+                     & valid_tok[:, None] & (contexts >= 0))         # (T, 2W)
+        pm = pair_mask.astype(jnp.float32)
+        npairs = pm.sum(axis=1)                                      # (T,)
+        active = (npairs > 0)
+
+        centers_id = jnp.where(valid_tok & active, block, sentinel_in)
+        ctx_id = jnp.where(pair_mask, contexts, sentinel_out)        # (T, 2W)
+        negs_c = sampler(k_neg, (t, negatives))                      # (T, K)
+        negs_id = jnp.where(active[:, None], negs_c, sentinel_out)
+
+        v = w_in[centers_id]                                         # (T, D)
+        u_pos = w_out[ctx_id]                                        # (T, 2W, D)
+        u_neg = w_out[negs_id]                                       # (T, K, D)
+
+        s_pos = jnp.einsum("td,twd->tw", v, u_pos)                   # (T, 2W)
+        s_neg = jnp.einsum("td,tkd->tk", v, u_neg)                   # (T, K)
+        g_pos = (jax.nn.sigmoid(s_pos) - 1.0) * pm                   # (T, 2W)
+        # negatives are shared across the center's pairs → their per-pair
+        # gradients coincide; the pair-mean is just sigmoid(s)
+        g_neg = jax.nn.sigmoid(s_neg) * active[:, None]              # (T, K)
+
+        # each of a center's npairs pairs contributes the same shared-negative
+        # term, so the negative loss scales by npairs
+        n_terms = pm.sum() * (1 + negatives)
+        loss = (-(jax.nn.log_sigmoid(s_pos) * pm).sum()
+                - (jax.nn.log_sigmoid(-s_neg).sum(axis=1) * npairs).sum()
+                ) / jnp.maximum(n_terms, 1.0)
+
+        # input-row gradient: pair-mean over the center's positive terms plus
+        # its (shared) negative terms — bounded by (1+K) sigmoid units
+        grad_v = (jnp.einsum("tw,twd->td", g_pos, u_pos)
+                  / jnp.maximum(npairs, 1.0)[:, None]
+                  + jnp.einsum("tk,tkd->td", g_neg, u_neg))          # (T, D)
+        grad_u_pos = jnp.einsum("tw,td->twd", g_pos, v)              # (T, 2W, D)
+        grad_u_neg = jnp.einsum("tk,td->tkd", g_neg, v)              # (T, K, D)
+
+        # scatter-MEAN across remaining duplicates (same word at several
+        # center positions / context slots)
+        dim = w_in.shape[1]
+        out_rows = jnp.concatenate(
+            [ctx_id.reshape(-1), negs_id.reshape(-1)])
+        out_grads = jnp.concatenate(
+            [grad_u_pos.reshape(-1, dim), grad_u_neg.reshape(-1, dim)])
+        in_count = jnp.zeros(w_in.shape[0], jnp.float32).at[centers_id].add(1.0)
+        out_count = jnp.zeros(w_out.shape[0], jnp.float32).at[out_rows].add(1.0)
+        w_in = w_in.at[centers_id].add(
+            -lr * grad_v / in_count[centers_id][:, None])
+        w_out = w_out.at[out_rows].add(
+            -lr * out_grads / out_count[out_rows][:, None])
+        return {"w_in": w_in, "w_out": w_out}, loss
+
+    if not jit:
+        return step
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def make_corpus_train_step(config: Word2VecConfig, dictionary: Dictionary):
+    """Scan-mode step: ONE device dispatch trains a whole (N, T) stack of
+    token blocks via ``lax.scan`` — host interaction per N·T tokens drops to
+    a single transfer + launch. step(params, key, blocks (N,T), lr) ->
+    (params, mean_loss). This is the throughput path for benchmarking and for
+    deployments where the corpus (or a shard of it) is staged in HBM."""
+    block_step = make_block_train_step(config, dictionary, jit=False)
+
+    def step(params, key, blocks, lr):
+        def body(carry, block):
+            params, key = carry
+            key, sub = jax.random.split(key)
+            params, loss = block_step(params, sub, block, lr)
+            return (params, key), loss
+
+        (params, _), losses = jax.lax.scan(body, (params, key), blocks)
+        return params, losses.mean()
 
     return jax.jit(step, donate_argnums=(0,))
 
@@ -239,11 +360,17 @@ class DeviceTrainer:
     ``Trainer::TrainIteration``."""
 
     def __init__(self, config: Word2VecConfig, dictionary: Dictionary,
-                 mesh=None) -> None:
+                 mesh=None, use_block_step: Optional[bool] = None) -> None:
         self.config = config
         self.dictionary = dictionary
         self.params = init_params(config, mesh)
-        self.step_fn = make_train_step(config, dictionary)
+        if use_block_step is None:
+            use_block_step = config.mode == "sg" and config.objective == "ns"
+        self.use_block_step = use_block_step
+        if use_block_step:
+            self.block_step_fn = make_block_train_step(config, dictionary)
+        else:
+            self.step_fn = make_train_step(config, dictionary)
         self.key = jax.random.PRNGKey(config.seed)
         self.keep = dictionary.keep_probs(config.sample)
         self.rng = np.random.default_rng(config.seed)
@@ -265,14 +392,25 @@ class DeviceTrainer:
     def train_block(self, block: np.ndarray, lr: Optional[float] = None) -> float:
         block = subsample_block(block, self.keep, self.rng)
         lr = self.config.lr if lr is None else lr
-        total_loss, batches = 0.0, 0
-        for batch in self._batches(block):
-            self.key, sub = jax.random.split(self.key)
-            self.params, loss = self.step_fn(self.params, sub, batch, lr)
-            total_loss += float(loss)
-            batches += 1
+        losses = []  # device values; sync ONCE at block end to keep steps pipelined
+        if self.use_block_step:
+            t = self.config.block_tokens
+            for i in range(0, len(block), t):
+                chunk = block[i:i + t]
+                if len(chunk) < t:  # pad the tail; -1 tokens are masked in-jit
+                    chunk = np.concatenate(
+                        [chunk, np.full(t - len(chunk), -1, np.int32)])
+                self.key, sub = jax.random.split(self.key)
+                self.params, loss = self.block_step_fn(
+                    self.params, sub, jnp.asarray(chunk), lr)
+                losses.append(loss)
+        else:
+            for batch in self._batches(block):
+                self.key, sub = jax.random.split(self.key)
+                self.params, loss = self.step_fn(self.params, sub, batch, lr)
+                losses.append(loss)
         self.words_trained += len(block)
-        return total_loss / max(batches, 1)
+        return float(np.mean([float(l) for l in losses])) if losses else 0.0
 
     def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
               log_every_s: float = 10.0) -> None:

@@ -1,0 +1,69 @@
+"""On-device categorical sampling for embedding training.
+
+The reference's negative sampler is a 1e8-slot host table indexed by a hash
+(``Applications/WordEmbedding/src/`` Sampler). On TPU, inverse-CDF
+``searchsorted`` is compact but costs a binary search of scalar gathers per
+draw (~160 µs / 1k draws measured on v5e) — it dominates the train step.
+
+The alias method (Walker 1977) gives O(1) per draw: one uniform picks a
+bucket, a second chooses between the bucket's resident and its alias. Two
+scalar gathers per draw, ~50× cheaper than searchsorted at vocab 100k.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_alias_table(probs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side O(V) construction: returns (threshold, alias) arrays.
+
+    Draw: ``i ~ U{0..V-1}; u ~ U[0,1); sample = i if u < threshold[i] else
+    alias[i]``.
+    """
+    probs = np.asarray(probs, dtype=np.float64)
+    v = len(probs)
+    probs = probs / probs.sum()
+    scaled = probs * v
+    threshold = np.zeros(v, dtype=np.float32)
+    alias = np.zeros(v, dtype=np.int32)
+    small = [i for i in range(v) if scaled[i] < 1.0]
+    large = [i for i in range(v) if scaled[i] >= 1.0]
+    work = scaled.copy()
+    while small and large:
+        s = small.pop()
+        l = large.pop()
+        threshold[s] = work[s]
+        alias[s] = l
+        work[l] -= 1.0 - work[s]
+        (small if work[l] < 1.0 else large).append(l)
+    for i in large + small:  # numerical leftovers: always accept
+        threshold[i] = 1.0
+        alias[i] = i
+    return threshold, alias
+
+
+def make_alias_sampler(probs: np.ndarray):
+    """Returns sample(key, shape) -> int32 ids, traceable under jit."""
+    threshold, alias = build_alias_table(probs)
+    thr = jnp.asarray(threshold)
+    ali = jnp.asarray(alias)
+    v = len(threshold)
+
+    def sample(key: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+        k1, k2 = jax.random.split(key)
+        idx = jax.random.randint(k1, shape, 0, v)
+        u = jax.random.uniform(k2, shape)
+        return jnp.where(u < thr[idx], idx, ali[idx]).astype(jnp.int32)
+
+    return sample
+
+
+def unigram_negative_sampler(counts: np.ndarray, power: float = 0.75):
+    """The word2vec negative distribution: counts^0.75, alias-sampled."""
+    p = np.asarray(counts, dtype=np.float64) ** power
+    return make_alias_sampler(p)
