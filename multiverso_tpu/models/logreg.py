@@ -1,0 +1,294 @@
+"""Logistic / softmax / FTRL regression — the second reference application,
+rebuilt TPU-first.
+
+Reference capability (not copied): the LogisticRegression app — linear /
+sigmoid / softmax / FTRL objectives, L1/L2 regularizers, dense or sparse
+features, local or parameter-server mode with sync-frequency pulls and a
+double-buffered pipeline, plus custom user tables
+(``Applications/LogisticRegression/src/``: logreg.cpp, model/, objective/,
+regular/, updater/).
+
+TPU-native re-design: one jitted train step per objective (dense einsum or
+padded-sparse gather/segment-dot on device); sparse minibatches are
+static-shape (B, max_nnz) index/value pads; the PS path reuses the framework
+ArrayTable (dense/sgd) or the FTRLTable extension table, with
+``sync_frequency`` pulls and an AsyncBuffer-style prefetch mirroring
+``ps_model.cpp:172-271``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu import log
+from multiverso_tpu.dashboard import monitor
+
+
+@dataclass(frozen=True)
+class LogRegConfig:
+    input_size: int                 # feature count (bias handled separately)
+    output_size: int = 1            # 1 → sigmoid/ftrl; >1 → softmax
+    objective: str = "sigmoid"      # "sigmoid" | "softmax" | "ftrl"
+    regular: str = "none"           # "none" | "l1" | "l2"
+    regular_coef: float = 0.0
+    lr: float = 0.1
+    minibatch: int = 256
+    sparse: bool = False
+    max_nnz: int = 64               # padded nnz per sparse sample
+    # PS-mode knobs (reference: ps_model.cpp)
+    use_ps: bool = False
+    sync_frequency: int = 1
+    pipeline: bool = False
+    # FTRL hyperparameters
+    alpha: float = 0.1
+    beta: float = 1.0
+    lambda1: float = 1.0
+    lambda2: float = 1.0
+    seed: int = 0
+
+
+def _dense_logits(w: jax.Array, x: jax.Array) -> jax.Array:
+    """w: (O, I+1) with bias column; x: (B, I)."""
+    return x @ w[:, :-1].T + w[:, -1]
+
+
+def _sparse_logits(w: jax.Array, idx: jax.Array, val: jax.Array) -> jax.Array:
+    """idx/val: (B, N) padded with idx=-1 → bias-only contribution masked.
+    w gathered per nonzero: (B, N, O)."""
+    mask = (idx >= 0).astype(val.dtype)
+    rows = jnp.maximum(idx, 0)
+    w_feat = w[:, :-1].T[rows]                      # (B, N, O)
+    contrib = jnp.einsum("bn,bno->bo", val * mask, w_feat)
+    return contrib + w[:, -1]
+
+
+def _grad_and_loss(config: LogRegConfig):
+    """Pure (w, batch) -> (grad, loss) for the configured objective."""
+    softmax = config.output_size > 1 and config.objective == "softmax"
+
+    def from_logits(logits, y):
+        if softmax:
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+            dlogits = (jnp.exp(logp)
+                       - jax.nn.one_hot(y, logits.shape[1])) / y.shape[0]
+        else:
+            yf = y.astype(logits.dtype).reshape(logits.shape)
+            p = jax.nn.sigmoid(logits)
+            eps = 1e-7
+            loss = -(yf * jnp.log(p + eps)
+                     + (1 - yf) * jnp.log(1 - p + eps)).mean()
+            dlogits = (p - yf) / y.shape[0]
+        return loss, dlogits
+
+    if not config.sparse:
+        def gl(w, batch):
+            x, y = batch["x"], batch["y"]
+            logits = _dense_logits(w, x)
+            loss, dlogits = from_logits(logits, y)
+            dlogits = dlogits.reshape(x.shape[0], -1)
+            grad_w = dlogits.T @ x                  # (O, I)
+            grad_b = dlogits.sum(axis=0)            # (O,)
+            return jnp.concatenate([grad_w, grad_b[:, None]], axis=1), loss
+        return gl
+
+    def gl_sparse(w, batch):
+        idx, val, y = batch["idx"], batch["val"], batch["y"]
+        logits = _sparse_logits(w, idx, val)
+        loss, dlogits = from_logits(logits, y)
+        dlogits = dlogits.reshape(idx.shape[0], -1)   # (B, O)
+        mask = (idx >= 0).astype(val.dtype)
+        rows = jnp.maximum(idx, 0)
+        # grad for feature f in sample b: val[b,n] * dlogits[b,:]
+        contrib = jnp.einsum("bn,bo->bno", val * mask, dlogits)
+        grad_w = jnp.zeros_like(w[:, :-1].T).at[rows.reshape(-1)].add(
+            contrib.reshape(-1, dlogits.shape[1]))  # (I, O)
+        grad_b = dlogits.sum(axis=0)
+        return jnp.concatenate([grad_w.T, grad_b[:, None]], axis=1), loss
+
+    return gl_sparse
+
+
+def _regularizer_grad(config: LogRegConfig):
+    if config.regular == "l2":
+        return lambda w: config.regular_coef * w
+    if config.regular == "l1":
+        return lambda w: config.regular_coef * jnp.sign(w)
+    return lambda w: jnp.zeros_like(w)
+
+
+class LogReg:
+    """Local-mode model: weights resident on device, jitted SGD train step
+    (reference ``Model`` vs ``PSModel`` factory — see :class:`PSLogReg`)."""
+
+    def __init__(self, config: LogRegConfig) -> None:
+        if config.objective == "ftrl" and not config.use_ps:
+            log.fatal("ftrl objective runs through the FTRL table (use_ps=True)")
+        self.config = config
+        rng = np.random.default_rng(config.seed)
+        self.w = jnp.asarray(
+            rng.normal(0, 0.01, (config.output_size, config.input_size + 1))
+            .astype(np.float32))
+        gl = _grad_and_loss(config)
+        reg = _regularizer_grad(config)
+
+        def train_step(w, batch, lr):
+            grad, loss = gl(w, batch)
+            return w - lr * (grad + reg(w)), loss
+
+        self._train = jax.jit(train_step, donate_argnums=(0,))
+        self._predict = jax.jit(self._predict_fn(gl))
+
+    def _predict_fn(self, gl):
+        config = self.config
+
+        def predict(w, batch):
+            if config.sparse:
+                logits = _sparse_logits(w, batch["idx"], batch["val"])
+            else:
+                logits = _dense_logits(w, batch["x"])
+            if config.output_size > 1:
+                return jnp.argmax(logits, axis=1)
+            return (jax.nn.sigmoid(logits) > 0.5).astype(jnp.int32).reshape(-1)
+
+        return predict
+
+    # -- API ---------------------------------------------------------------
+    def update(self, batch: Dict[str, np.ndarray],
+               lr: Optional[float] = None) -> float:
+        with monitor("LOGREG_UPDATE"):
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.w, loss = self._train(self.w, batch,
+                                       self.config.lr if lr is None else lr)
+            return float(loss)
+
+    def predict(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        return np.asarray(self._predict(self.w, batch))
+
+    def test(self, batch: Dict[str, np.ndarray]) -> float:
+        pred = self.predict(batch)
+        return float((pred == np.asarray(batch["y"]).reshape(-1)).mean())
+
+    def weights(self) -> np.ndarray:
+        return np.asarray(self.w)
+
+
+class PSLogReg(LogReg):
+    """Parameter-server mode: weights live in an ArrayTable (sgd/sigmoid/
+    softmax) or an FTRLTable; local replica syncs every ``sync_frequency``
+    minibatches, optionally via a prefetch double buffer
+    (reference: ``ps_model.cpp:172-271`` GetPipelineTable)."""
+
+    def __init__(self, config: LogRegConfig) -> None:
+        import multiverso_tpu as mv
+        self.config = config
+        self._n = config.output_size * (config.input_size + 1)
+        gl = _grad_and_loss(config)
+        reg = _regularizer_grad(config)
+        self._gl = jax.jit(gl)
+        self._reg = jax.jit(reg)
+        self._predict = jax.jit(self._predict_fn(gl))
+        if config.objective == "ftrl":
+            from multiverso_tpu.tables.ftrl_table import FTRLWorker
+            mv.register_table_type("ftrl", FTRLWorker)
+            self.table = mv.create_table(
+                "ftrl", self._n, alpha=config.alpha, beta=config.beta,
+                lambda1=config.lambda1, lambda2=config.lambda2)
+        else:
+            self.table = mv.create_table(
+                "array", self._n, np.float32, updater_type="sgd")
+        self.w = jnp.asarray(self._pull())
+        self._batches_since_sync = 0
+        self._pending_get: Optional[int] = None
+
+    def _pull(self) -> np.ndarray:
+        return self.table.get().reshape(self.config.output_size,
+                                        self.config.input_size + 1)
+
+    def update(self, batch: Dict[str, np.ndarray],
+               lr: Optional[float] = None) -> float:
+        lr = self.config.lr if lr is None else lr
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        grad, loss = self._gl(self.w, batch)
+        push = grad + self._reg(self.w)
+        if self.config.objective == "ftrl":
+            self.table.add_async(np.asarray(push).reshape(-1))
+        else:
+            # sgd updater applies data -= delta: ship lr-scaled gradient
+            self.table.add_async(lr * np.asarray(push).reshape(-1))
+        self._batches_since_sync += 1
+        if self._batches_since_sync >= self.config.sync_frequency:
+            self._sync()
+        return float(loss)
+
+    def _sync(self) -> None:
+        self._batches_since_sync = 0
+        with monitor("PS_LOGREG_PULL"):
+            if self.config.pipeline and self._pending_get is not None:
+                raw = self.table.wait(self._pending_get)
+                self.w = jnp.asarray(
+                    np.asarray(raw).reshape(self.config.output_size, -1))
+                self._pending_get = self.table.get_async()
+            elif self.config.pipeline:
+                self._pending_get = self.table.get_async()
+                self.w = jnp.asarray(self._pull())
+            else:
+                self.w = jnp.asarray(self._pull())
+
+    def finish(self) -> None:
+        if self._pending_get is not None:
+            self.table.wait(self._pending_get)
+            self._pending_get = None
+        self.w = jnp.asarray(self._pull())
+
+
+def make_model(config: LogRegConfig) -> LogReg:
+    """Reference factory (`Model::Get` on use_ps): local vs PS model."""
+    return PSLogReg(config) if config.use_ps else LogReg(config)
+
+
+# -- data ------------------------------------------------------------------
+
+def parse_libsvm_line(line: str, max_nnz: int) -> Tuple[int, np.ndarray, np.ndarray]:
+    parts = line.split()
+    label = int(float(parts[0]))
+    idx = np.full(max_nnz, -1, np.int32)
+    val = np.zeros(max_nnz, np.float32)
+    for i, tok in enumerate(parts[1:max_nnz + 1]):
+        k, _, v = tok.partition(":")
+        idx[i] = int(k)
+        val[i] = float(v) if v else 1.0
+    return label, idx, val
+
+
+def load_libsvm(path: str, max_nnz: int = 64) -> Dict[str, np.ndarray]:
+    """Load a LibSVM-format file into padded sparse batch arrays."""
+    from multiverso_tpu.io import TextReader
+    labels, idxs, vals = [], [], []
+    reader = TextReader(path)
+    while (line := reader.get_line()) is not None:
+        if not line.strip():
+            continue
+        y, idx, val = parse_libsvm_line(line, max_nnz)
+        labels.append(y)
+        idxs.append(idx)
+        vals.append(val)
+    reader.close()
+    return {"y": np.array(labels, np.int32), "idx": np.stack(idxs),
+            "val": np.stack(vals)}
+
+
+def minibatches(data: Dict[str, np.ndarray], batch_size: int,
+                rng: Optional[np.random.Generator] = None
+                ) -> Iterator[Dict[str, np.ndarray]]:
+    n = len(data["y"])
+    order = rng.permutation(n) if rng is not None else np.arange(n)
+    for i in range(0, n - batch_size + 1, batch_size):
+        sl = order[i:i + batch_size]
+        yield {k: v[sl] for k, v in data.items()}

@@ -1,0 +1,112 @@
+"""FTRL table — proof of the table-extension API.
+
+Reference capability (not copied): LogisticRegression defines custom
+user-level tables — ``FTRLWorkerTable/FTRLServerTable`` with struct-valued
+entries ``FTRLEntry{z, n}`` where the *server* runs the FTRL-proximal update
+and Get materializes weights from (z, n)
+(``Applications/LogisticRegression/src/util/ftrl_sparse_table.h:12-90``).
+
+TPU-native re-design: (z, n) are two HBM-sharded arrays beside no weight
+array at all — weights are *derived on device* inside the Get gather (the
+FTRL closed form), so the server never stores stale w. Add ships raw
+gradients; the whole update is one jitted donated call.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu import log
+from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.runtime.zoo import Zoo
+from multiverso_tpu.tables.base import ServerTable, WorkerTable
+
+
+def ftrl_weights(z: jax.Array, n: jax.Array, alpha: float, beta: float,
+                 lambda1: float, lambda2: float) -> jax.Array:
+    """Closed-form FTRL-proximal weights from accumulator state."""
+    shrunk = jnp.sign(z) * jnp.maximum(jnp.abs(z) - lambda1, 0.0)
+    denom = (beta + jnp.sqrt(n)) / alpha + lambda2
+    return -shrunk / denom
+
+
+class FTRLServer(ServerTable):
+    def __init__(self, size: int, alpha: float = 0.1, beta: float = 1.0,
+                 lambda1: float = 1.0, lambda2: float = 1.0) -> None:
+        super().__init__()
+        zoo = Zoo.instance()
+        self.size = int(size)
+        self.alpha, self.beta = float(alpha), float(beta)
+        self.lambda1, self.lambda2 = float(lambda1), float(lambda2)
+        self.mesh = zoo.mesh
+        self.padded = mesh_lib.pad_to_multiple(self.size, zoo.num_servers)
+        sharding = mesh_lib.table_sharding(self.mesh, ndim=1)
+        self.z = jax.device_put(np.zeros(self.padded, np.float32), sharding)
+        self.n = jax.device_put(np.zeros(self.padded, np.float32), sharding)
+
+        a, b, l1, l2 = self.alpha, self.beta, self.lambda1, self.lambda2
+
+        def update(z, n, grad):
+            w = ftrl_weights(z, n, a, b, l1, l2)
+            sigma = (jnp.sqrt(n + grad * grad) - jnp.sqrt(n)) / a
+            z = z + grad - sigma * w
+            n = n + grad * grad
+            return z, n
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+        self._weights = jax.jit(
+            lambda z, n: ftrl_weights(z, n, a, b, l1, l2))
+
+    def process_add(self, request: Tuple[np.ndarray, Any]) -> None:
+        grad, _option = request
+        grad = np.asarray(grad, np.float32).reshape(-1)
+        if grad.size != self.size:
+            log.fatal("FTRLTable.add: grad size %d != %d", grad.size, self.size)
+        if self.padded != self.size:
+            grad = np.pad(grad, (0, self.padded - self.size))
+        self.z, self.n = self._update(self.z, self.n, jnp.asarray(grad))
+
+    def process_get(self, request: Any) -> np.ndarray:
+        w = self._weights(self.z, self.n)
+        return np.asarray(jax.device_get(w))[: self.size]
+
+    def store(self, stream) -> None:
+        from multiverso_tpu.checkpoint import write_array
+        write_array(stream, np.asarray(jax.device_get(self.z))[: self.size])
+        write_array(stream, np.asarray(jax.device_get(self.n))[: self.size])
+
+    def load(self, stream) -> None:
+        from multiverso_tpu.checkpoint import read_array
+        z = read_array(stream)
+        n = read_array(stream)
+        sharding = mesh_lib.table_sharding(self.mesh, ndim=1)
+        pad = self.padded - self.size
+        self.z = jax.device_put(np.pad(z.astype(np.float32), (0, pad)), sharding)
+        self.n = jax.device_put(np.pad(n.astype(np.float32), (0, pad)), sharding)
+
+
+class FTRLWorker(WorkerTable):
+    """Client proxy: ``add`` ships raw gradients, ``get`` returns the derived
+    FTRL weights."""
+
+    def __init__(self, size: int, alpha: float = 0.1, beta: float = 1.0,
+                 lambda1: float = 1.0, lambda2: float = 1.0,
+                 server: Optional[FTRLServer] = None) -> None:
+        super().__init__()
+        self.size = int(size)
+        self._server_table = server or FTRLServer(size, alpha, beta,
+                                                  lambda1, lambda2)
+        self._register(self._server_table)
+
+    def get(self) -> np.ndarray:
+        return super().get(None)
+
+    def add(self, grad: np.ndarray) -> None:
+        super().add((grad, None))
+
+    def add_async(self, grad: np.ndarray) -> int:
+        return super().add_async((grad, None))
