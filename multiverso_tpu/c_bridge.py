@@ -1,0 +1,138 @@
+"""Python side of the C API bridge (reference: ``src/c_api.cpp`` marshalling).
+
+Called by the embedded interpreter inside ``libmultiverso_tpu.so``. The C
+shim passes raw host pointers wrapped as memoryviews; this module views them
+as numpy arrays (zero-copy) and drives the real table API. Handles are small
+ints so they pack into the reference's ``void*`` TableHandler.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+_tables: Dict[int, object] = {}
+_next_handle = [1]
+_lock = threading.Lock()
+
+
+def _register(table) -> int:
+    with _lock:
+        handle = _next_handle[0]
+        _next_handle[0] += 1
+        _tables[handle] = table
+        return handle
+
+
+def _f32(view, size) -> np.ndarray:
+    return np.frombuffer(view, dtype=np.float32, count=size)
+
+
+def _i32(view, count) -> np.ndarray:
+    return np.frombuffer(view, dtype=np.int32, count=count)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def init(argv: List[str]) -> None:
+    mv.init(argv)
+
+
+def shutdown() -> None:
+    with _lock:
+        _tables.clear()
+    mv.shutdown()
+
+
+def barrier() -> None:
+    mv.barrier()
+
+
+def num_workers() -> int:
+    return mv.num_workers()
+
+
+def num_servers() -> int:
+    return mv.num_servers()
+
+
+def worker_id() -> int:
+    return mv.worker_id()
+
+
+def server_id() -> int:
+    return mv.server_id()
+
+
+def rank() -> int:
+    return mv.rank()
+
+
+def size() -> int:
+    return mv.size()
+
+
+def set_flag(name: str, value: str) -> None:
+    mv.set_flag(name, value)
+
+
+# -- array table -------------------------------------------------------------
+
+def new_array_table(size: int) -> int:
+    return _register(mv.create_table("array", size, np.float32))
+
+
+def array_get(handle: int, view, size: int) -> None:
+    out = _f32(view, size)
+    np.copyto(out, _tables[handle].get())
+
+
+def array_add(handle: int, view, size: int, async_: int) -> None:
+    delta = _f32(view, size).copy()
+    table = _tables[handle]
+    if async_:
+        table.add_async(delta)
+    else:
+        table.add(delta)
+
+
+# -- matrix table ------------------------------------------------------------
+
+def new_matrix_table(num_row: int, num_col: int) -> int:
+    return _register(mv.create_table("matrix", num_row, num_col, np.float32))
+
+
+def matrix_get_all(handle: int, view, size: int) -> None:
+    out = _f32(view, size)
+    np.copyto(out, _tables[handle].get().reshape(-1))
+
+
+def matrix_add_all(handle: int, view, size: int, async_: int) -> None:
+    table = _tables[handle]
+    delta = _f32(view, size).copy().reshape(table.num_row, table.num_col)
+    if async_:
+        table.add_async(delta)
+    else:
+        table.add(delta)
+
+
+def matrix_get_rows(handle: int, view, size: int, ids_view, n: int) -> None:
+    table = _tables[handle]
+    ids = _i32(ids_view, n)
+    out = _f32(view, size)
+    np.copyto(out, table.get(ids).reshape(-1))
+
+
+def matrix_add_rows(handle: int, view, size: int, ids_view, n: int,
+                    async_: int) -> None:
+    table = _tables[handle]
+    ids = _i32(ids_view, n).copy()
+    delta = _f32(view, size).copy().reshape(n, table.num_col)
+    if async_:
+        table.add_async(delta, row_ids=ids)
+    else:
+        table.add(delta, row_ids=ids)

@@ -1,0 +1,153 @@
+#include "allocator.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+namespace mvtpu {
+
+namespace {
+
+// Block header layout: [ bucket_or_size | atomic refcount | pad to align ]
+struct Header {
+  uint64_t bucket;                // pool bucket (smart) or raw size (default)
+  std::atomic<int> refcount;
+};
+
+constexpr size_t kHeaderSlot = 32;  // aligned room reserved before payload
+
+inline Header* header_of(char* data) {
+  return reinterpret_cast<Header*>(data - kHeaderSlot);
+}
+
+inline char* raw_alloc(size_t payload, size_t alignment) {
+  size_t total = kHeaderSlot + payload;
+  void* raw = nullptr;
+  size_t align = alignment < alignof(Header) ? alignof(Header) : alignment;
+  if (posix_memalign(&raw, align < sizeof(void*) ? sizeof(void*) : align,
+                     total) != 0) {
+    throw std::bad_alloc();
+  }
+  return static_cast<char*>(raw) + kHeaderSlot;
+}
+
+inline uint64_t bucket_for(size_t size) {
+  uint64_t b = 32;
+  while (b < size) b <<= 1;
+  return b;
+}
+
+}  // namespace
+
+char* DefaultAllocator::Alloc(size_t size) {
+  char* data = raw_alloc(size, alignment_);
+  Header* h = header_of(data);
+  h->bucket = size;
+  new (&h->refcount) std::atomic<int>(1);
+  return data;
+}
+
+void DefaultAllocator::Free(char* data) {
+  if (data == nullptr) return;
+  Header* h = header_of(data);
+  if (h->refcount.fetch_sub(1) == 1) {
+    std::free(reinterpret_cast<char*>(h));
+  }
+}
+
+void DefaultAllocator::Refer(char* data) {
+  header_of(data)->refcount.fetch_add(1);
+}
+
+struct SmartAllocator::Impl {
+  size_t alignment;
+  std::mutex mutex;
+  std::unordered_map<uint64_t, std::vector<char*>> free_lists;
+};
+
+SmartAllocator::SmartAllocator(size_t alignment) : impl_(new Impl) {
+  impl_->alignment = alignment;
+}
+
+SmartAllocator::~SmartAllocator() {
+  std::lock_guard<std::mutex> lock(impl_->mutex);
+  for (auto& kv : impl_->free_lists) {
+    for (char* data : kv.second) {
+      std::free(reinterpret_cast<char*>(header_of(data)));
+    }
+  }
+  delete impl_;
+}
+
+char* SmartAllocator::Alloc(size_t size) {
+  uint64_t bucket = bucket_for(size);
+  {
+    std::lock_guard<std::mutex> lock(impl_->mutex);
+    auto it = impl_->free_lists.find(bucket);
+    if (it != impl_->free_lists.end() && !it->second.empty()) {
+      char* data = it->second.back();
+      it->second.pop_back();
+      pooled_.fetch_sub(1);
+      live_.fetch_add(1);
+      Header* h = header_of(data);
+      h->refcount.store(1);
+      return data;
+    }
+  }
+  char* data = raw_alloc(bucket, impl_->alignment);
+  Header* h = header_of(data);
+  h->bucket = bucket;
+  new (&h->refcount) std::atomic<int>(1);
+  live_.fetch_add(1);
+  return data;
+}
+
+void SmartAllocator::Free(char* data) {
+  if (data == nullptr) return;
+  Header* h = header_of(data);
+  if (h->refcount.fetch_sub(1) == 1) {
+    std::lock_guard<std::mutex> lock(impl_->mutex);
+    impl_->free_lists[h->bucket].push_back(data);
+    live_.fetch_sub(1);
+    pooled_.fetch_add(1);
+  }
+}
+
+void SmartAllocator::Refer(char* data) {
+  header_of(data)->refcount.fetch_add(1);
+}
+
+Allocator* Allocator::Get() {
+  static SmartAllocator instance;
+  return &instance;
+}
+
+}  // namespace mvtpu
+
+// Flat C exports for the ctypes binding / tests.
+extern "C" {
+
+void* MVTPU_Alloc(size_t size) { return mvtpu::Allocator::Get()->Alloc(size); }
+
+void MVTPU_Free(void* data) {
+  mvtpu::Allocator::Get()->Free(static_cast<char*>(data));
+}
+
+void MVTPU_Refer(void* data) {
+  mvtpu::Allocator::Get()->Refer(static_cast<char*>(data));
+}
+
+size_t MVTPU_AllocatorLiveBlocks() {
+  return static_cast<mvtpu::SmartAllocator*>(mvtpu::Allocator::Get())
+      ->live_blocks();
+}
+
+size_t MVTPU_AllocatorPooledBlocks() {
+  return static_cast<mvtpu::SmartAllocator*>(mvtpu::Allocator::Get())
+      ->pooled_blocks();
+}
+
+}  // extern "C"

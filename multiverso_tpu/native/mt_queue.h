@@ -1,0 +1,69 @@
+// Blocking MPMC queue with exit poison — native twin of the Python
+// multiverso_tpu.utils.MtQueue (reference capability:
+// include/multiverso/util/mt_queue.h). Used by the C-API bridge's async
+// request path so FFI hosts get true fire-and-forget Adds.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace mvtpu {
+
+template <typename T>
+class MtQueue {
+ public:
+  void Push(T item) {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      items_.push_back(std::move(item));
+    }
+    nonempty_.notify_one();
+  }
+
+  // Blocking pop; returns false once Exit() was called and the queue drained.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lock(mutex_);
+    nonempty_.wait(lock, [this] { return !items_.empty() || !alive_; });
+    if (items_.empty()) return false;
+    *out = std::move(items_.front());
+    items_.pop_front();
+    return true;
+  }
+
+  bool TryPop(T* out) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (items_.empty()) return false;
+    *out = std::move(items_.front());
+    items_.pop_front();
+    return true;
+  }
+
+  size_t Size() const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    return items_.size();
+  }
+
+  bool Empty() const { return Size() == 0; }
+
+  void Exit() {
+    {
+      std::lock_guard<std::mutex> lock(mutex_);
+      alive_ = false;
+    }
+    nonempty_.notify_all();
+  }
+
+  bool Alive() const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    return alive_;
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::condition_variable nonempty_;
+  std::deque<T> items_;
+  bool alive_ = true;
+};
+
+}  // namespace mvtpu

@@ -1,0 +1,102 @@
+"""Wire sparsification (SparseFilter) — host-hop payload compression.
+
+Reference capability (not copied): ``SparseFilter<data,index>`` encodes a
+blob as (index, value) pairs when >50% zeros, with a size side-channel;
+``OneBitsFilter`` was an empty stub
+(``include/multiverso/util/quantization_util.h:37-161``).
+
+TPU-era role: only host hops (C-API bridge, external clients, checkpoint
+streams) benefit — on-mesh traffic is XLA collectives. The codec is the
+native C++ one (``native/sparse_filter.cpp``) loaded via ctypes, with a pure
+numpy fallback when the shared library isn't built. Both produce the same
+byte format (magic 'MVSF').
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+_MAGIC = 0x4653564D  # 'MVSF'
+
+_native: Optional[ctypes.CDLL] = None
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _native
+    if _native is not None:
+        return _native
+    path = os.path.join(os.path.dirname(__file__), "..", "native",
+                        "libmultiverso_tpu.so")
+    try:
+        lib = ctypes.CDLL(os.path.abspath(path))
+        # size_t SparseEncodeC(const float*, size_t, uint8_t*, size_t)
+        lib.MVTPU_SparseEncode.restype = ctypes.c_size_t
+        lib.MVTPU_SparseEncode.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t]
+        lib.MVTPU_SparseDecode.restype = ctypes.c_int
+        lib.MVTPU_SparseDecode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_size_t]
+        _native = lib
+    except (OSError, AttributeError):
+        _native = None
+    return _native
+
+
+def sparse_encode(data: np.ndarray, force_numpy: bool = False) -> bytes:
+    """Encode a float32 array; sparse form when <50% nonzero."""
+    data = np.ascontiguousarray(data, dtype=np.float32).reshape(-1)
+    lib = None if force_numpy else _load_native()
+    if lib is not None:
+        # worst case: header(16) + nnz(8) + count*(4+4)
+        cap = 24 + data.size * 8
+        out = np.empty(cap, dtype=np.uint8)
+        n = lib.MVTPU_SparseEncode(
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), data.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), cap)
+        return out[:n].tobytes()
+    nz = np.nonzero(data)[0]
+    sparse = 2 * len(nz) < data.size
+    header = struct.pack("<IIQ", _MAGIC, 1 if sparse else 0, data.size)
+    if not sparse:
+        return header + data.tobytes()
+    pairs = np.empty((len(nz), 2), dtype=np.uint32)
+    pairs[:, 0] = nz.astype(np.uint32)
+    pairs[:, 1] = data[nz].view(np.uint32)
+    return header + struct.pack("<Q", len(nz)) + pairs.tobytes()
+
+
+def sparse_decode(payload: bytes, count: int,
+                  force_numpy: bool = False) -> np.ndarray:
+    lib = None if force_numpy else _load_native()
+    if lib is not None:
+        out = np.zeros(count, dtype=np.float32)
+        buf = np.frombuffer(payload, dtype=np.uint8)
+        ok = lib.MVTPU_SparseDecode(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(payload),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), count)
+        if not ok:
+            raise ValueError("malformed sparse payload")
+        return out
+    magic, kind, n = struct.unpack_from("<IIQ", payload, 0)
+    if magic != _MAGIC or n != count:
+        raise ValueError("malformed sparse payload")
+    if kind == 0:
+        return np.frombuffer(payload, dtype=np.float32, count=count,
+                             offset=16).copy()
+    (nnz,) = struct.unpack_from("<Q", payload, 16)
+    pairs = np.frombuffer(payload, dtype=np.uint32, count=nnz * 2,
+                          offset=24).reshape(nnz, 2)
+    out = np.zeros(count, dtype=np.float32)
+    out[pairs[:, 0]] = pairs[:, 1].view(np.float32)
+    return out
+
+
+def native_available() -> bool:
+    return _load_native() is not None
