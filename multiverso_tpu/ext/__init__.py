@@ -1,0 +1,29 @@
+"""Binding-extension layer: shared variables, model param managers, callbacks.
+
+Capability parity with the reference's framework glue
+(``binding/python/multiverso/theano_ext/`` — ``sharedvar.py``,
+``param_manager.py``, ``lasagne_ext/param_manager.py``,
+``keras_ext/param_manager.py`` + ``keras_ext/callbacks.py`` — and the
+Torch-Lua handlers in ``binding/lua/``), re-targeted at the frameworks that
+matter on TPU: JAX pytrees (flax / haiku / optax states) and torch modules.
+
+The sync contract is the reference's exactly (``sharedvar.py:34-49``): a
+shared value keeps a snapshot of the last value pulled from the table;
+``sync()`` pushes ``current - snapshot`` (the accumulated local delta, i.e.
+the effective gradient steps since the last sync) and pulls the merged global
+value back.
+"""
+
+from multiverso_tpu.ext.sharedvar import (SharedArray, mv_shared,
+                                          shared_vars,
+                                          sync_all_shared_vars)
+from multiverso_tpu.ext.param_manager import (ParamManager,
+                                              PytreeParamManager,
+                                              TorchParamManager)
+from multiverso_tpu.ext.callbacks import MVCallback
+
+__all__ = [
+    "SharedArray", "mv_shared", "shared_vars", "sync_all_shared_vars",
+    "ParamManager", "PytreeParamManager", "TorchParamManager",
+    "MVCallback",
+]

@@ -1,0 +1,143 @@
+"""Model parameter managers: a whole model's params in ONE ArrayTable.
+
+Parity with ``binding/python/multiverso/theano_ext/param_manager.py:9-90``
+(``MVModelParamManager``) and its lasagne/keras subclasses: flatten every
+parameter into a single 1-D table; ``sync_all_param()`` pushes the delta
+since the last sync and writes the merged global value back into the model.
+
+TPU-era managers:
+
+* :class:`PytreeParamManager` — any JAX pytree of arrays (flax ``params``
+  dicts, haiku params, optax states). Pytrees are immutable, so the manager
+  owns the current tree (``.params``) and ``sync()`` returns the merged one.
+* :class:`TorchParamManager` — a ``torch.nn.Module`` (parity with the
+  Torch-Lua binding's per-parameter handlers, ``binding/lua/``, and the
+  keras manager's get/set-weights shape).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+class ParamManager:
+    """Base manager. Subclasses implement :meth:`get_all_param_values` /
+    :meth:`set_all_param_values` over lists of numpy arrays
+    (``param_manager.py:43-59`` contract)."""
+
+    def __init__(self) -> None:
+        values = self.get_all_param_values()
+        self._shapes = [v.shape for v in values]
+        self._dtypes = [v.dtype for v in values]
+        self._sizes = [int(v.size) for v in values]
+        flat = np.concatenate(
+            [np.asarray(v, dtype=np.float32).reshape(-1) for v in values]
+        ) if values else np.zeros(0, np.float32)
+        # master-only Add into a zero table: shard-consistent under
+        # multi-process SPMD (see sharedvar.py seeding note)
+        self._table = mv.create_table("array", flat.size, np.float32)
+        if mv.is_master_worker():
+            self._table.add(flat)
+        from multiverso_tpu.runtime.zoo import Zoo
+        Zoo.instance().process_barrier()
+        self._last_synced = self._table.get()
+        self._set_from_flat(self._last_synced)
+
+    # -- subclass surface ---------------------------------------------------
+    def get_all_param_values(self) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def set_all_param_values(self, values: Sequence[np.ndarray]) -> None:
+        raise NotImplementedError
+
+    # -- internals ----------------------------------------------------------
+    def _flat(self) -> np.ndarray:
+        values = self.get_all_param_values()
+        if not values:
+            return np.zeros(0, np.float32)
+        return np.concatenate(
+            [np.asarray(v, dtype=np.float32).reshape(-1) for v in values])
+
+    def _set_from_flat(self, flat: np.ndarray) -> None:
+        out, n = [], 0
+        for shape, dtype, size in zip(self._shapes, self._dtypes, self._sizes):
+            out.append(flat[n:n + size].reshape(shape).astype(dtype))
+            n += size
+        self.set_all_param_values(out)
+
+    @property
+    def table(self):
+        return self._table
+
+    # -- API ----------------------------------------------------------------
+    def sync_all_param(self) -> None:
+        """Push local delta, pull merged params, write back into the model
+        (``param_manager.py:70-83``)."""
+        current = self._flat()
+        self._table.add(current - self._last_synced)
+        self._last_synced = self._table.get()
+        self._set_from_flat(self._last_synced)
+
+    sync = sync_all_param
+
+
+class PytreeParamManager(ParamManager):
+    """Manage a JAX pytree of arrays (flax/haiku/optax)."""
+
+    def __init__(self, params: Any) -> None:
+        import jax
+        self._jax = jax
+        self._leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        super().__init__()
+
+    @property
+    def params(self) -> Any:
+        return self._jax.tree_util.tree_unflatten(self._treedef, self._leaves)
+
+    @params.setter
+    def params(self, tree: Any) -> None:
+        leaves, treedef = self._jax.tree_util.tree_flatten(tree)
+        if treedef != self._treedef:
+            mv.log.fatal("pytree structure changed across sync")
+        self._leaves = leaves
+
+    def get_all_param_values(self) -> List[np.ndarray]:
+        return [np.asarray(leaf) for leaf in self._leaves]
+
+    def set_all_param_values(self, values: Sequence[np.ndarray]) -> None:
+        import jax.numpy as jnp
+        self._leaves = [jnp.asarray(v) for v in values]
+
+    def sync(self, params: Any = None) -> Any:
+        """Functional spelling: ``params = manager.sync(params)``."""
+        if params is not None:
+            self.params = params
+        self.sync_all_param()
+        return self.params
+
+    sync_all_param = ParamManager.sync_all_param
+
+
+class TorchParamManager(ParamManager):
+    """Manage a ``torch.nn.Module``'s parameters."""
+
+    def __init__(self, module: Any) -> None:
+        self._module = module
+        super().__init__()
+
+    @property
+    def module(self) -> Any:
+        return self._module
+
+    def get_all_param_values(self) -> List[np.ndarray]:
+        return [p.detach().cpu().numpy() for p in self._module.parameters()]
+
+    def set_all_param_values(self, values: Sequence[np.ndarray]) -> None:
+        import torch
+        with torch.no_grad():
+            for p, v in zip(self._module.parameters(), values):
+                p.copy_(torch.from_numpy(np.ascontiguousarray(v)))

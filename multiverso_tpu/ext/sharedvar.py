@@ -1,0 +1,91 @@
+"""Shared-variable wrapper over an ArrayTable.
+
+Parity with ``binding/python/multiverso/theano_ext/sharedvar.py:12-99``
+(``MVSharedVariable`` / ``mv_shared`` / ``sync_all_mv_shared_vars``): a
+host value of any shape is mirrored into a 1-D table; ``sync()`` adds the
+local delta since the last sync and pulls the merged value. Only the master
+worker's ``init_value`` seeds the table (``sharedvar.py:24-25`` contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+import multiverso_tpu as mv
+
+
+class SharedArray:
+    """A host array mirrored into a distributed ArrayTable.
+
+    Unlike the theano original there is no wrapped framework object — the
+    value is a plain ndarray; framework glue lives in
+    :mod:`multiverso_tpu.ext.param_manager`.
+    """
+
+    def __init__(self, value: Any, dtype: Any = np.float32,
+                 table=None) -> None:
+        value = np.asarray(value, dtype=dtype)
+        self._shape = value.shape
+        self._dtype = value.dtype
+        if table is None:
+            # seed via a master-only Add into a zero table (the reference's
+            # scheme, sharedvar.py:24-25): under multi-process SPMD every
+            # process materializes identical zero shards, then exactly one
+            # worker's delta lands — a per-process init_value would leave
+            # non-master hosts' shards zeroed
+            table = mv.create_table("array", value.size, self._dtype)
+            if mv.is_master_worker():
+                table.add(value.reshape(-1))
+        self._table = table
+        # seed must be visible before the first pull; process-level barrier
+        # (a per-worker mv.barrier() would deadlock single-caller construction)
+        from multiverso_tpu.runtime.zoo import Zoo
+        Zoo.instance().process_barrier()
+        self._last_synced = self._table.get().reshape(self._shape)
+        self._value = self._last_synced.copy()
+
+    @property
+    def value(self) -> np.ndarray:
+        return self._value
+
+    @value.setter
+    def value(self, new: Any) -> None:
+        new = np.asarray(new, dtype=self._dtype)
+        if new.shape != self._shape:
+            mv.log.fatal("SharedArray shape mismatch: %s vs %s",
+                         new.shape, self._shape)
+        self._value = new
+
+    @property
+    def table(self):
+        return self._table
+
+    def sync(self) -> np.ndarray:
+        """Push ``value - last_synced`` and pull the merged global value."""
+        self._table.add((self._value - self._last_synced).reshape(-1))
+        merged = self._table.get().reshape(self._shape)
+        self._value = merged.copy()
+        self._last_synced = merged
+        return self._value
+
+    # reference spelling
+    mv_sync = sync
+
+
+shared_vars: List[SharedArray] = []
+
+
+def mv_shared(value: Any, dtype: Any = np.float32) -> SharedArray:
+    """Create a :class:`SharedArray` and record it in the global registry
+    (``sharedvar.py:79-88``)."""
+    sv = SharedArray(value, dtype)
+    shared_vars.append(sv)
+    return sv
+
+
+def sync_all_shared_vars() -> None:
+    """Sync every registry entry (``sync_all_mv_shared_vars`` parity)."""
+    for sv in shared_vars:
+        sv.sync()

@@ -11,27 +11,36 @@ namespace mvtpu {
 
 namespace {
 
-// Block header layout: [ bucket_or_size | atomic refcount | pad to align ]
+// Block header layout: [ bucket_or_size | slot offset | atomic refcount ],
+// placed in the 32 bytes immediately before the payload. The slot (distance
+// from the malloc'd base to the payload) is a multiple of the requested
+// alignment so the payload honors alignments > 32 too.
 struct Header {
   uint64_t bucket;                // pool bucket (smart) or raw size (default)
+  uint32_t slot;                  // payload - slot == malloc'd base
   std::atomic<int> refcount;
 };
 
-constexpr size_t kHeaderSlot = 32;  // aligned room reserved before payload
+constexpr size_t kHeaderSlot = 32;  // header room reserved before payload
+static_assert(sizeof(Header) <= kHeaderSlot, "header must fit the slot");
 
 inline Header* header_of(char* data) {
   return reinterpret_cast<Header*>(data - kHeaderSlot);
 }
 
+inline char* base_of(char* data) { return data - header_of(data)->slot; }
+
 inline char* raw_alloc(size_t payload, size_t alignment) {
-  size_t total = kHeaderSlot + payload;
-  void* raw = nullptr;
   size_t align = alignment < alignof(Header) ? alignof(Header) : alignment;
-  if (posix_memalign(&raw, align < sizeof(void*) ? sizeof(void*) : align,
-                     total) != 0) {
+  if (align < sizeof(void*)) align = sizeof(void*);
+  size_t slot = kHeaderSlot > align ? kHeaderSlot : align;
+  void* raw = nullptr;
+  if (posix_memalign(&raw, align, slot + payload) != 0) {
     throw std::bad_alloc();
   }
-  return static_cast<char*>(raw) + kHeaderSlot;
+  char* data = static_cast<char*>(raw) + slot;
+  header_of(data)->slot = static_cast<uint32_t>(slot);
+  return data;
 }
 
 inline uint64_t bucket_for(size_t size) {
@@ -54,7 +63,7 @@ void DefaultAllocator::Free(char* data) {
   if (data == nullptr) return;
   Header* h = header_of(data);
   if (h->refcount.fetch_sub(1) == 1) {
-    std::free(reinterpret_cast<char*>(h));
+    std::free(base_of(data));
   }
 }
 
@@ -76,7 +85,7 @@ SmartAllocator::~SmartAllocator() {
   std::lock_guard<std::mutex> lock(impl_->mutex);
   for (auto& kv : impl_->free_lists) {
     for (char* data : kv.second) {
-      std::free(reinterpret_cast<char*>(header_of(data)));
+      std::free(base_of(data));
     }
   }
   delete impl_;

@@ -19,7 +19,6 @@
 
 namespace {
 
-bool g_owns_interpreter = false;
 PyObject* g_bridge = nullptr;  // multiverso_tpu.c_bridge module
 
 void FatalPython(const char* where) {
@@ -33,7 +32,13 @@ void EnsureInterpreter() {
   std::call_once(once, [] {
     if (!Py_IsInitialized()) {
       Py_InitializeEx(0);
-      g_owns_interpreter = true;
+      // Py_InitializeEx leaves the calling thread holding the GIL; release
+      // it so other host threads' PyGILState_Ensure can proceed while this
+      // thread runs plain C code. Every entry point re-acquires via Gil.
+      // The interpreter is deliberately never finalized: tearing down an
+      // embedded CPython with JAX/XLA loaded is unsafe, and hosts that
+      // MV_ShutDown may keep running.
+      PyEval_SaveThread();
     }
   });
 }
@@ -109,6 +114,8 @@ void MV_Init(int* argc, char* argv[]) {
 void MV_ShutDown() {
   Gil gil;
   Py_DECREF(Call("shutdown", nullptr));
+  Py_XDECREF(g_bridge);
+  g_bridge = nullptr;  // a later MV_Init re-imports the bridge
 }
 
 void MV_Barrier() {

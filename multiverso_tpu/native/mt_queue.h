@@ -1,7 +1,8 @@
 // Blocking MPMC queue with exit poison — native twin of the Python
 // multiverso_tpu.utils.MtQueue (reference capability:
-// include/multiverso/util/mt_queue.h). Used by the C-API bridge's async
-// request path so FFI hosts get true fire-and-forget Adds.
+// include/multiverso/util/mt_queue.h). Header-only building block for
+// native hosts; the C-API bridge currently delegates async Adds to the
+// Python-side queue and does not use this yet.
 #pragma once
 
 #include <condition_variable>

@@ -24,12 +24,16 @@ import numpy as np
 _MAGIC = 0x4653564D  # 'MVSF'
 
 _native: Optional[ctypes.CDLL] = None
+_native_load_attempted = False
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
-    global _native
-    if _native is not None:
+    # cache failure too: without the .so built, retrying dlopen on every
+    # encode/decode would tax the hot wire-compression path
+    global _native, _native_load_attempted
+    if _native_load_attempted:
         return _native
+    _native_load_attempted = True
     path = os.path.join(os.path.dirname(__file__), "..", "native",
                         "libmultiverso_tpu.so")
     try:

@@ -1,0 +1,129 @@
+"""Binding-extension layer tests (reference:
+binding/python/multiverso/tests/test_multiverso.py sharedvar cases +
+theano_ext/param_manager.py sync contract)."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import ext
+from multiverso_tpu.ext import (MVCallback, PytreeParamManager, SharedArray,
+                                TorchParamManager, mv_shared,
+                                sync_all_shared_vars)
+
+
+@pytest.fixture(autouse=True)
+def clear_registry():
+    ext.sharedvar.shared_vars.clear()
+    yield
+    ext.sharedvar.shared_vars.clear()
+
+
+def test_shared_array_init_and_sync(mv_env):
+    v = np.arange(12, dtype=np.float32).reshape(3, 4)
+    sv = SharedArray(v)
+    np.testing.assert_allclose(sv.value, v)
+
+    # local update → sync pushes the delta
+    sv.value = sv.value + 1.0
+    merged = sv.sync()
+    np.testing.assert_allclose(merged, v + 1.0)
+    np.testing.assert_allclose(sv.table.get().reshape(3, 4), v + 1.0)
+
+    # another worker's add arrives → next sync pulls it even with no local change
+    sv.table.add(np.ones(12, np.float32))
+    sv.sync()
+    np.testing.assert_allclose(sv.value, v + 2.0)
+
+
+def test_shared_array_delta_is_since_last_sync(mv_env):
+    sv = SharedArray(np.zeros(4, np.float32))
+    sv.value = np.full(4, 3.0, np.float32)
+    sv.sync()
+    sv.value = sv.value + 2.0  # delta should be exactly +2, not +5
+    sv.sync()
+    np.testing.assert_allclose(sv.table.get(), np.full(4, 5.0))
+
+
+def test_non_master_init_contributes_zeros():
+    mv.init(local_workers=2)
+    with mv.worker(1):
+        assert not mv.is_master_worker()
+        sv = SharedArray(np.full(6, 7.0, np.float32))
+    np.testing.assert_allclose(sv.value, np.zeros(6))
+    mv.shutdown()
+
+
+def test_mv_shared_registry_and_sync_all(mv_env):
+    a = mv_shared(np.zeros(3, np.float32))
+    b = mv_shared(np.ones(2, np.float32))
+    a.value = a.value + 1.0
+    b.value = b.value + 1.0
+    sync_all_shared_vars()
+    np.testing.assert_allclose(a.table.get(), np.ones(3))
+    np.testing.assert_allclose(b.table.get(), np.full(2, 2.0))
+
+
+def test_pytree_param_manager(mv_env):
+    import jax
+
+    params = {"w": np.arange(12, dtype=np.float32).reshape(4, 3),
+              "b": np.zeros(3, np.float32)}
+    pm = PytreeParamManager(params)
+    np.testing.assert_allclose(np.asarray(pm.params["w"]), params["w"])
+
+    stepped = jax.tree_util.tree_map(lambda x: x + 1.0, pm.params)
+    merged = pm.sync(stepped)
+    np.testing.assert_allclose(np.asarray(merged["w"]), params["w"] + 1.0)
+    np.testing.assert_allclose(np.asarray(merged["b"]), np.ones(3))
+
+    # simulate a peer worker's delta landing in the shared table
+    pm.table.add(np.ones(15, np.float32))
+    merged = pm.sync()
+    np.testing.assert_allclose(np.asarray(merged["b"]), np.full(3, 2.0))
+
+
+def test_pytree_structure_change_fatal(mv_env):
+    pm = PytreeParamManager({"w": np.zeros(2, np.float32)})
+    with pytest.raises(mv.log.FatalError):
+        pm.sync({"w": np.zeros(2, np.float32), "extra": np.zeros(1)})
+
+
+def test_torch_param_manager(mv_env):
+    torch = pytest.importorskip("torch")
+
+    module = torch.nn.Linear(3, 2)
+    ref = [p.detach().clone() for p in module.parameters()]
+    pm = TorchParamManager(module)
+
+    with torch.no_grad():
+        for p in module.parameters():
+            p += 1.0
+    pm.sync_all_param()
+    for p, r in zip(module.parameters(), ref):
+        np.testing.assert_allclose(p.detach().numpy(), r.numpy() + 1.0,
+                                   rtol=1e-6)
+
+    n = sum(int(p.numel()) for p in module.parameters())
+    pm.table.add(np.ones(n, np.float32))
+    pm.sync_all_param()
+    for p, r in zip(module.parameters(), ref):
+        np.testing.assert_allclose(p.detach().numpy(), r.numpy() + 2.0,
+                                   rtol=1e-6)
+
+
+def test_callback_sync_frequency(mv_env):
+    class CountingManager:
+        def __init__(self):
+            self.syncs = 0
+
+        def sync_all_param(self):
+            self.syncs += 1
+
+    cm = CountingManager()
+    cb = MVCallback(cm, freq=2)
+    for b in range(4):
+        cb.on_batch_end(b)
+    assert cm.syncs == 2  # batches 0 and 2
+    cb.on_epoch_end(0)
+    assert cm.syncs == 3
