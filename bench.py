@@ -9,8 +9,14 @@ words/sec, the canonical per-thread rate of the reference's C hot loop
 ``Applications/WordEmbedding/src/trainer.cpp:44-48``; 100k/thread is the
 standard figure for word2vec-style CPU loops on one modern core).
 
-Extra fields: MatrixTable row Add/Get device-path p50 latency (BASELINE
-target < 50 µs) and effective scatter/gather bandwidth.
+Extra fields: MatrixTable row Add/Get device-path timings at the reference
+perf-harness shape (1M×50 fp32, ``Test/test_matrix_perf.cpp:32-45``) plus
+dense whole-table bandwidth.
+
+Timing note: every measurement is *fetch-forced* — a 1-element device→host
+read after the op chain. ``jax.block_until_ready`` alone can return before
+device work completes on tunneled-TPU runtimes, inflating throughput ~2000×
+on scatter chains (measured); a dependent fetch cannot lie.
 """
 
 import json
@@ -19,14 +25,17 @@ import time
 import numpy as np
 
 
-def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40,
-                   warmup=3):
+def _fetch(x):
+    """Force full completion of everything `x` depends on."""
+    return np.asarray(x)
+
+
+def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
     import jax
-    import jax.numpy as jnp
 
     from multiverso_tpu.models.vocab import Dictionary
     from multiverso_tpu.models.word2vec import (Word2VecConfig, init_params,
-                                                make_block_train_step)
+                                                make_corpus_train_step)
 
     counts = np.maximum((1e7 / np.arange(1, vocab + 1)).astype(np.int64), 5)
     d = Dictionary()
@@ -38,7 +47,6 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40,
     params = init_params(config, mesh=None)
     # scan-mode: ONE dispatch per n_blocks — measures the chip, not the
     # host/tunnel round-trip
-    from multiverso_tpu.models.word2vec import make_corpus_train_step
     step = make_corpus_train_step(config, d)
 
     # zipf-ish synthetic corpus, sampled via inverse CDF
@@ -51,69 +59,116 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40,
 
     key = jax.random.PRNGKey(0)
     key, sub = jax.random.split(key)
-    params, loss = step(params, sub, stack_dev[:warmup], config.lr)  # compile small
-    key, sub = jax.random.split(key)
-    params, loss = step(params, sub, stack_dev, config.lr)           # compile full
-    jax.block_until_ready(params["w_in"])
+    params, loss = step(params, sub, stack_dev, config.lr)  # compile
+    _fetch(params["w_in"][0, :1])
 
-    key, sub = jax.random.split(key)
-    t0 = time.perf_counter()
-    params, loss = step(params, sub, stack_dev, config.lr)
-    jax.block_until_ready(params["w_in"])
-    dt = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        t0 = time.perf_counter()
+        params, loss = step(params, sub, stack_dev, config.lr)
+        _fetch(params["w_in"][0, :1])
+        best = min(best, time.perf_counter() - t0)
     words = n_blocks * block_tokens
-    return words / dt, float(loss)
+    # loss is from ONE pass over a 327k-token synthetic corpus — barely off
+    # init (ln 2 ≈ 0.6931); convergence is covered by tests/test_word2vec.py
+    return words / best, float(loss)
 
 
-def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024, iters=50):
-    """Device-path row scatter-add / gather on a 1M×50 fp32 table (the
-    reference perf harness shape, Test/test_matrix_perf.cpp:32-45)."""
+def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
+    """Device-path row Add/Get on the reference perf-harness table
+    (1M×50 fp32, physically 128-lane padded like ``MatrixServer``).
+
+    Add = the Pallas row-DMA scatter (the production linear-updater path on
+    TPU, ~8× XLA's scatter); Get = XLA dynamic gather (faster than per-row
+    DMA). Timing = scan-length slope (T(k2)−T(k1))/(k2−k1) inside single
+    dispatches with per-step-varying ids — immune to the tunnel's fixed
+    materialization cost, CSE, and async-dispatch underreporting.
+    """
     import jax
+    import jax.lax as lax
     import jax.numpy as jnp
 
-    import jax.lax as lax
+    from multiverso_tpu.parallel.mesh import pad_to_multiple
+    padded_cols = pad_to_multiple(cols, 128)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from multiverso_tpu.ops.pallas_rows import scatter_add_rows
+        add_op = scatter_add_rows
+    else:
+        def add_op(t, i, v):
+            return t.at[i].add(v)
 
-    data = jnp.zeros((rows, cols), jnp.float32)
-    # chain `iters` ops inside one dispatch (lax.scan) so the per-op time
-    # reflects device latency, not the host/tunnel round-trip
-    n_id_sets = 8
     rng = np.random.default_rng(0)
-    ids_stack = jax.device_put(
-        rng.integers(0, rows, (n_id_sets, batch_rows)).astype(np.int32))
-    vals = jax.device_put(np.ones((batch_rows, cols), np.float32))
+    base = jax.device_put(
+        rng.choice(rows, batch_rows, replace=False).astype(np.int32))
+    vals = jax.device_put(np.ones((batch_rows, padded_cols), np.float32))
 
-    @jax.jit
-    def add_chain(d):
-        def body(d, i):
-            return d.at[ids_stack[i % n_id_sets]].add(vals), 0.0
-        d, _ = lax.scan(body, d, jnp.arange(iters))
-        return d
+    def make_add(iters):
+        @jax.jit
+        def f(d, base, vals):
+            def body(tab, i):
+                ids = (base + i * 7919) % rows
+                return add_op(tab, ids, vals), 0.0
+            tab, _ = lax.scan(body, d, jnp.arange(iters))
+            return tab[0, :1]
+        return f
 
-    @jax.jit
-    def get_chain(d):
-        def body(acc, i):
-            return acc + d[ids_stack[i % n_id_sets]].sum(), 0.0
-        acc, _ = lax.scan(body, 0.0, jnp.arange(iters))
-        return acc
+    def make_get(iters):
+        @jax.jit
+        def f(d, base):
+            def body(acc, i):
+                ids = (base + i * 7919) % rows
+                return acc + d[ids].sum(), 0.0
+            acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(iters))
+            return acc
+        return f
 
-    data = add_chain(data)
-    jax.block_until_ready(data)        # compile
-    jax.block_until_ready(get_chain(data))
+    def slope(makef, args, k1=100, k2=1100):
+        f1, f2 = makef(k1), makef(k2)
+        _fetch(f1(*args))
+        _fetch(f2(*args))
+        def best(f):
+            b = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                _fetch(f(*args))
+                b = min(b, time.perf_counter() - t0)
+            return b
+        # clamp: timer noise on fast backends can invert the two points
+        return max((best(f2) - best(f1)) / (k2 - k1), 1e-9)
 
-    t0 = time.perf_counter()
-    data = add_chain(data)
-    jax.block_until_ready(data)
-    add_per_op = (time.perf_counter() - t0) / iters
-    t0 = time.perf_counter()
-    jax.block_until_ready(get_chain(data))
-    get_per_op = (time.perf_counter() - t0) / iters
+    data = jnp.zeros((rows, padded_cols), jnp.float32)
+    k1, k2 = (100, 1100) if on_tpu else (2, 12)
+    add_per_op = slope(make_add, (data, base, vals), k1, k2)
+    get_per_op = slope(make_get, (data, base), k1, k2)
 
-    bytes_moved = batch_rows * cols * 4
+    # dense whole-table pass (the reference's get-all path): incremental
+    # cost of 10 extra donated passes over one fetch
+    dense = jax.jit(lambda d: d + 1.0, donate_argnums=(0,))
+    d2 = dense(jnp.zeros((rows, padded_cols), jnp.float32))
+    _fetch(d2[0, :1])
+    def dense_time(extra):
+        nonlocal d2
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(extra):
+                d2 = dense(d2)
+            _fetch(d2[0, :1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+    n_extra = 10 if on_tpu else 2
+    dense_per_pass = (dense_time(n_extra) - dense_time(0)) / n_extra
+    dense_bytes = rows * padded_cols * 4 * 2  # read + write
+
+    batch_bytes = batch_rows * cols * 4
     return {
         "matrix_add_p50_us": round(add_per_op * 1e6, 1),
         "matrix_get_p50_us": round(get_per_op * 1e6, 1),
-        "matrix_add_gbps": round(bytes_moved / add_per_op / 1e9, 2),
-        "matrix_get_gbps": round(bytes_moved / get_per_op / 1e9, 2),
+        "matrix_add_gbps": round(batch_bytes / add_per_op / 1e9, 2),
+        "matrix_get_gbps": round(batch_bytes / get_per_op / 1e9, 2),
+        "matrix_dense_gbps": round(dense_bytes / max(dense_per_pass, 1e-9) / 1e9, 1),
     }
 
 

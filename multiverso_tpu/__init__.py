@@ -123,6 +123,43 @@ def aggregate(data: np.ndarray) -> np.ndarray:
     return Zoo.instance().aggregate(data)
 
 
+# -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------
+# External (off-mesh) hosts — the reference's CNTK/C# deployment shape
+# (include/multiverso/multiverso.h:60-65, ZMQ Bind/Connect mode) — drive the
+# transport directly without starting the PS runtime.
+
+_raw_net = None
+
+
+def net_bind(rank: int, endpoint: str) -> str:
+    """Listen on ``host:port`` (port 0 → ephemeral); returns the bound
+    endpoint."""
+    global _raw_net
+    from multiverso_tpu.runtime.net import TcpNet
+    if _raw_net is None:
+        _raw_net = TcpNet()
+    return _raw_net.bind(rank, endpoint)
+
+
+def net_connect(endpoints: Sequence[str]) -> None:
+    """Provide the full rank→endpoint map; connections dial lazily."""
+    if _raw_net is None:
+        log.fatal("net_connect: call net_bind first")
+    _raw_net.connect(list(endpoints))
+
+
+def net_finalize() -> None:
+    global _raw_net
+    if _raw_net is not None:
+        _raw_net.finalize()
+        _raw_net = None
+
+
+def net() :
+    """The raw-net transport (None until net_bind)."""
+    return _raw_net
+
+
 # -- tables -----------------------------------------------------------------
 
 from multiverso_tpu.tables.array_table import ArrayServer, ArrayWorker  # noqa: E402

@@ -1,0 +1,318 @@
+"""Host-side network transport + collectives for external (off-mesh) clients.
+
+Reference capability (not copied): the ``NetInterface`` seam with MPI/ZMQ
+backends (``include/multiverso/net.h:15-49``, ``net/mpi_net.h``,
+``net/zmq_net.h``) and the hand-rolled ``AllreduceEngine``
+(``include/multiverso/net/allreduce_engine.h:80-168``).
+
+TPU-era role: ON the mesh, worker↔server traffic is XLA collectives over
+ICI — no host transport exists and the Bruck/recursive-halving algorithm
+choice is XLA's job (SURVEY §2.2). What survives is the OFF-mesh surface the
+reference served with ZMQ's explicit Bind/Connect mode: external CPU-resident
+clients (C-API hosts, data feeders, multi-process CPU deployments without a
+JAX distributed runtime) that need rank-to-rank messaging and host
+collectives. This module provides that: a TCP transport with the reference's
+message framing semantics (typed header + length-prefixed blobs) and a ring
+allreduce/allgather engine built on the raw send/recv channel.
+
+Two channels per peer, like the reference's split between mailbox traffic
+(``Send/Recv`` via the Communicator) and raw blocking transfers
+(``SendTo/RecvFrom/SendRecv`` used by the AllreduceEngine):
+
+* channel 0 — mailbox: frames land in a shared recv queue (``recv()``)
+* channel 1 — raw: frames land in a per-peer queue (``recv_from(rank)``)
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu import log
+from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.utils import MtQueue
+
+_MAGIC = 0x4D565450  # 'MVTP'
+_HEADER = struct.Struct("<IBiiiiqi")  # magic, channel, src, dst, type, table, msg_id, nblobs
+_BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
+
+
+def _pack_blob(arr: np.ndarray) -> Tuple[bytes, bytes]:
+    arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode()[:8].ljust(8, b" ")
+    payload = arr.tobytes()
+    head = _BLOB.pack(arr.ndim, dt, len(payload)) + struct.pack(
+        f"<{arr.ndim}q", *arr.shape)
+    return head, payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def get_local_ip() -> str:
+    """Best-effort local IP (reference net_util::GetLocalIPAddress parity)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def parse_machine_file(path: str) -> List[str]:
+    """One ``host[:port]`` per line; rank = line index (zmq_net.h machine-file
+    contract). Default port from the ``port`` flag."""
+    from multiverso_tpu.config import get_flag
+    endpoints = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" not in line:
+                line = f"{line}:{get_flag('port')}"
+            endpoints.append(line)
+    return endpoints
+
+
+class TcpNet:
+    """Rank-to-rank TCP transport with explicit Bind/Connect (the reference
+    ZMQ backend's raw-net mode for external hosts)."""
+
+    def __init__(self) -> None:
+        self.rank = -1
+        self.size = 0
+        self._endpoints: List[str] = []
+        self._listener: Optional[socket.socket] = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._mailbox: MtQueue = MtQueue()
+        self._raw: Dict[int, MtQueue] = {}
+        self._accept_thread: Optional[threading.Thread] = None
+        self._accepted: list = []
+        self._active = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def bind(self, rank: int, endpoint: str) -> str:
+        """Listen on ``host:port`` (port 0 → ephemeral); returns the bound
+        endpoint (MV_NetBind parity)."""
+        host, port = endpoint.rsplit(":", 1)
+        self.rank = rank
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(64)
+        # wildcard/loopback binds must advertise a dialable address
+        adv_host = get_local_ip() if host in ("0.0.0.0", "::", "") else host
+        bound = f"{adv_host}:{self._listener.getsockname()[1]}"
+        self._active = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"mvtpu-net-accept-{rank}")
+        self._accept_thread.start()
+        return bound
+
+    def connect(self, endpoints: Sequence[str]) -> None:
+        """Record the full rank→endpoint map (MV_NetConnect parity).
+        Connections are dialed lazily on first send."""
+        self._endpoints = list(endpoints)
+        self.size = len(endpoints)
+        for r in range(self.size):
+            self._raw.setdefault(r, MtQueue())
+
+    def init(self, rank: int, endpoints: Sequence[str]) -> None:
+        """bind + connect in one step (symmetric deployments)."""
+        self.bind(rank, endpoints[rank])
+        self.connect(endpoints)
+
+    def finalize(self) -> None:
+        self._active = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            for sock in list(self._conns.values()) + self._accepted:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            self._accepted.clear()
+        self._mailbox.exit()
+        for q in self._raw.values():
+            q.exit()
+
+    # -- send ---------------------------------------------------------------
+    def send(self, msg: Message) -> int:
+        return self._send(msg, channel=0)
+
+    def send_to(self, rank: int, blobs: List[np.ndarray]) -> int:
+        msg = Message(src=self.rank, dst=rank, type=MsgType.Request_Get,
+                      data=blobs)
+        return self._send(msg, channel=1)
+
+    def recv(self) -> Optional[Message]:
+        """Pop the next mailbox message (blocks; None on shutdown)."""
+        return self._mailbox.pop()
+
+    def recv_from(self, rank: int) -> Optional[List[np.ndarray]]:
+        msg = self._raw[rank].pop()
+        return None if msg is None else msg.data
+
+    def send_recv(self, dst: int, blobs: List[np.ndarray],
+                  src: int) -> Optional[List[np.ndarray]]:
+        self.send_to(dst, blobs)
+        return self.recv_from(src)
+
+    # -- internals ----------------------------------------------------------
+    def _send(self, msg: Message, channel: int) -> int:
+        sock = self._socket_for(msg.dst)
+        parts = [b""]  # placeholder for header
+        total = 0
+        for arr in msg.data:
+            head, payload = _pack_blob(np.asarray(arr))
+            parts.append(head)
+            parts.append(payload)
+            total += len(payload)
+        parts[0] = _HEADER.pack(_MAGIC, channel, msg.src, msg.dst,
+                                int(msg.type), msg.table_id, msg.msg_id,
+                                len(msg.data))
+        frame = b"".join(parts)
+        with self._send_locks.setdefault(msg.dst, threading.Lock()):
+            sock.sendall(frame)
+        return total
+
+    def _socket_for(self, rank: int) -> socket.socket:
+        with self._conn_lock:
+            sock = self._conns.get(rank)
+        if sock is not None:
+            return sock
+        if not (0 <= rank < len(self._endpoints)):
+            log.fatal("net: no endpoint for rank %d", rank)
+        host, port = self._endpoints[rank].rsplit(":", 1)
+        sock = socket.create_connection((host, int(port)), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._conn_lock:
+            # keep the first established connection per peer
+            existing = self._conns.get(rank)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._conns[rank] = sock
+        return sock
+
+    def _accept_loop(self) -> None:
+        while self._active:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._accepted.append(conn)
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True,
+                             name=f"mvtpu-net-recv-{self.rank}").start()
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while self._active:
+                head = _read_exact(conn, _HEADER.size)
+                magic, channel, src, dst, mtype, table_id, msg_id, nblobs = (
+                    _HEADER.unpack(head))
+                if magic != _MAGIC:
+                    log.error("net: bad frame magic %x", magic)
+                    return
+                blobs = []
+                for _ in range(nblobs):
+                    bh = _read_exact(conn, _BLOB.size)
+                    ndim, dt, nbytes = _BLOB.unpack(bh)
+                    shape = struct.unpack(
+                        f"<{ndim}q", _read_exact(conn, 8 * ndim))
+                    payload = _read_exact(conn, nbytes)
+                    blobs.append(np.frombuffer(
+                        payload, dtype=np.dtype(dt.decode().strip())
+                    ).reshape(shape).copy())
+                msg = Message(src=src, dst=dst, type=MsgType(mtype),
+                              table_id=table_id, msg_id=msg_id, data=blobs)
+                if channel == 1:
+                    self._raw.setdefault(src, MtQueue()).push(msg)
+                else:
+                    self._mailbox.push(msg)
+        except (ConnectionError, OSError):
+            return
+
+
+class AllreduceEngine:
+    """Host collectives over the raw channel (reference AllreduceEngine
+    capability). On-mesh the algorithm choice (Bruck allgather /
+    recursive-halving reduce-scatter) belongs to XLA; here a ring
+    reduce-scatter + ring allgather covers the host path, which is
+    latency-dominated at external-client scales."""
+
+    def __init__(self, net: TcpNet) -> None:
+        self.net = net
+
+    def allreduce(self, data: np.ndarray) -> np.ndarray:
+        """Elementwise sum across all ranks; every rank gets the result."""
+        n, r = self.net.size, self.net.rank
+        if n <= 1:
+            return np.asarray(data).copy()
+        flat = np.asarray(data).reshape(-1)
+        pad = (-flat.size) % n
+        work = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        chunks = np.split(work.copy(), n)
+        right = (r + 1) % n
+        left = (r - 1) % n
+        # ring reduce-scatter: after n-1 steps chunk (r+1)%n is fully reduced
+        for step in range(n - 1):
+            send_idx = (r - step) % n
+            recv_idx = (r - step - 1) % n
+            got = self.net.send_recv(right, [chunks[send_idx]], left)
+            if got is None:
+                log.fatal("allreduce: transport shut down mid-collective")
+            chunks[recv_idx] = chunks[recv_idx] + got[0]
+        # ring allgather of the reduced chunks
+        for step in range(n - 1):
+            send_idx = (r - step + 1) % n
+            recv_idx = (r - step) % n
+            got = self.net.send_recv(right, [chunks[send_idx]], left)
+            if got is None:
+                log.fatal("allreduce: transport shut down mid-collective")
+            chunks[recv_idx] = got[0]
+        out = np.concatenate(chunks)
+        if pad:
+            out = out[:flat.size]
+        return out.reshape(np.asarray(data).shape)
+
+    def allgather(self, data: np.ndarray) -> List[np.ndarray]:
+        """Every rank's array, in rank order (reference Allgather parity)."""
+        n, r = self.net.size, self.net.rank
+        parts: List[Optional[np.ndarray]] = [None] * n
+        parts[r] = np.asarray(data).copy()
+        right = (r + 1) % n
+        left = (r - 1) % n
+        for step in range(n - 1):
+            send_idx = (r - step) % n
+            got = self.net.send_recv(right, [parts[send_idx]], left)
+            if got is None:
+                log.fatal("allgather: transport shut down mid-collective")
+            parts[(r - step - 1) % n] = got[0]
+        return parts  # type: ignore[return-value]

@@ -63,27 +63,30 @@ class MatrixServer(ServerTable):
         if self.padded_rows == self.num_row:
             self.padded_rows += num_shards
         self.sentinel_row = self.num_row
+        # Pad cols to the 128-lane width: XLA's physical TPU layout already
+        # tiles the minor dim to 128, so this costs no extra HBM — and it
+        # unlocks the Pallas row-DMA scatter path (ops/pallas_rows), which
+        # is ~8x faster than XLA's serialized scatter for row Adds.
+        self.padded_cols = mesh_lib.pad_to_multiple(self.num_col, 128)
 
         sharding = mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0)
+        init = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
         if init_value is not None:
-            init = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
-            init[: self.num_row] = np.asarray(init_value, dtype=self.dtype).reshape(
-                self.num_row, self.num_col)
+            init[: self.num_row, : self.num_col] = np.asarray(
+                init_value, dtype=self.dtype).reshape(self.num_row, self.num_col)
         elif init_range is not None:
             # random-init server ctor overload (reference: matrix_table.cpp:372-384)
             lo, hi = init_range
             rng = np.random.default_rng(seed)
-            init = rng.uniform(lo, hi, size=(self.padded_rows, self.num_col)).astype(self.dtype)
-            init[self.num_row:] = 0
-        else:
-            init = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
+            init[: self.num_row, : self.num_col] = rng.uniform(
+                lo, hi, size=(self.num_row, self.num_col)).astype(self.dtype)
         self.data = jax.device_put(init, sharding)
 
         self.updater = get_updater(self.dtype, updater_type)
         worker_dim = self.num_workers if self.updater.per_worker_state else 1
         self.states: Dict[str, jax.Array] = {}
         for name, (shape_suffix, sdtype) in self.updater.state_spec(
-                (self.padded_rows, self.num_col), self.dtype).items():
+                (self.padded_rows, self.padded_cols), self.dtype).items():
             s_shard = mesh_lib.table_sharding(self.mesh, ndim=3, shard_dim=1)
             self.states[name] = jax.device_put(
                 np.zeros((worker_dim,) + tuple(shape_suffix), dtype=sdtype), s_shard)
@@ -98,8 +101,14 @@ class MatrixServer(ServerTable):
         self._linear = type(self.updater) in (Updater, SGDUpdater)
         self._sign = -1.0 if isinstance(self.updater, SGDUpdater) else 1.0
         self._gather = jax.jit(lambda data, ids: data[ids])
-        self._scatter_add = jax.jit(
-            lambda data, ids, delta: data.at[ids].add(delta), donate_argnums=(0,))
+        self._pallas_scatter = jax.default_backend() == "tpu"
+        if self._pallas_scatter:
+            from multiverso_tpu.ops.pallas_rows import scatter_add_rows
+            self._scatter_add = scatter_add_rows  # unique-id contract: see process_add
+        else:
+            self._scatter_add = jax.jit(
+                lambda data, ids, delta: data.at[ids].add(delta),
+                donate_argnums=(0,))
         self._row_update = self._make_row_update(self.updater)
 
     def _make_row_update(self, updater: Updater):
@@ -125,14 +134,15 @@ class MatrixServer(ServerTable):
         """Pad (ids, values) to a power-of-two bucket aimed at the sentinel
         scratch row so jit traces are shape-stable."""
         n = len(ids)
-        bucket = _next_pow2(n)
+        # min bucket 16 = pallas ROW_GROUP (batch must be a group multiple)
+        bucket = max(_next_pow2(n), 16)
         pad = bucket - n
         ids_p = np.concatenate([ids, np.full(pad, self.sentinel_row, dtype=ids.dtype)])
         vals_p = None
         if values is not None:
-            vals_p = np.concatenate(
-                [values, np.zeros((pad, self.num_col), dtype=values.dtype)], axis=0)
-            vals_p = jnp.asarray(vals_p)
+            padded = np.zeros((bucket, self.padded_cols), dtype=values.dtype)
+            padded[:n, : self.num_col] = values
+            vals_p = jnp.asarray(padded)
         return jnp.asarray(ids_p), vals_p, n
 
     # -- server ops --------------------------------------------------------
@@ -142,9 +152,9 @@ class MatrixServer(ServerTable):
         scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
         worker = jnp.int32(option.worker_id % max(1, self.num_workers))
         if row_ids is None:
-            delta = np.asarray(values, dtype=self.dtype).reshape(self.num_row, self.num_col)
-            if self.padded_rows != self.num_row:
-                delta = np.pad(delta, ((0, self.padded_rows - self.num_row), (0, 0)))
+            delta = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
+            delta[: self.num_row, : self.num_col] = np.asarray(
+                values, dtype=self.dtype).reshape(self.num_row, self.num_col)
             self.data, self.states = self._whole_update(
                 self.data, self.states, jnp.asarray(delta), worker, scalars)
             touched: Optional[np.ndarray] = None
@@ -153,8 +163,11 @@ class MatrixServer(ServerTable):
             values = np.asarray(values, dtype=self.dtype).reshape(-1, self.num_col)
             if len(row_ids) != len(values):
                 log.fatal("Matrix.add: %d ids but %d value rows", len(row_ids), len(values))
-            if not self._linear:
-                # stateful updaters need unique ids: pre-aggregate duplicates
+            # unique ids: required by stateful updaters (one apply per row)
+            # and by the pallas scatter kernel's in-place row DMA contract;
+            # XLA's scatter-add handles duplicates natively, so the linear
+            # non-pallas path skips the host-side aggregation
+            if not (self._linear and not self._pallas_scatter):
                 row_ids, inv = np.unique(row_ids, return_inverse=True)
                 agg = np.zeros((len(row_ids), self.num_col), dtype=values.dtype)
                 np.add.at(agg, inv, values)
@@ -179,10 +192,11 @@ class MatrixServer(ServerTable):
             if self.is_sparse and option is not None:
                 return self._sparse_get(option)
             out = self.updater.access(self.data)
-            return np.asarray(jax.device_get(out))[: self.num_row]
+            return np.asarray(jax.device_get(out))[: self.num_row, : self.num_col]
         row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
         ids_p, _, n = self._bucket_ids(row_ids, None)
-        rows = np.asarray(jax.device_get(self._gather(self.data, ids_p)))[:n]
+        rows = np.asarray(jax.device_get(
+            self._gather(self.data, ids_p)))[:n, : self.num_col]
         if self.is_sparse and option is not None:
             with self._std_lock:
                 self._up_to_date[option.worker_id % self.num_workers, row_ids] = True
@@ -197,21 +211,24 @@ class MatrixServer(ServerTable):
         if len(stale) == 0:
             return stale, np.zeros((0, self.num_col), dtype=self.dtype)
         if len(stale) == self.num_row:
-            return stale, np.asarray(jax.device_get(self.data))[: self.num_row]
+            return stale, np.asarray(
+                jax.device_get(self.data))[: self.num_row, : self.num_col]
         ids_p, _, n = self._bucket_ids(stale, None)
-        rows = np.asarray(jax.device_get(self._gather(self.data, ids_p)))[:n]
+        rows = np.asarray(jax.device_get(
+            self._gather(self.data, ids_p)))[:n, : self.num_col]
         return stale, rows
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
         from multiverso_tpu.checkpoint import write_array
-        write_array(stream, np.asarray(jax.device_get(self.data))[: self.num_row])
+        write_array(stream, np.asarray(
+            jax.device_get(self.data))[: self.num_row, : self.num_col])
 
     def load(self, stream) -> None:
         from multiverso_tpu.checkpoint import read_array
         arr = read_array(stream).astype(self.dtype).reshape(self.num_row, self.num_col)
-        padded = np.zeros((self.padded_rows, self.num_col), dtype=self.dtype)
-        padded[: self.num_row] = arr
+        padded = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
+        padded[: self.num_row, : self.num_col] = arr
         self.data = jax.device_put(
             padded, mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0))
 

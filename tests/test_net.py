@@ -1,0 +1,158 @@
+"""Host transport + collectives tests (reference: Test/test_net.cpp raw
+send/recv ping-pong and Test/test_allreduce.cpp)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.runtime.net import AllreduceEngine, TcpNet, get_local_ip
+
+
+def _make_world(n):
+    """n TcpNet instances bound to ephemeral localhost ports."""
+    nets = [TcpNet() for _ in range(n)]
+    endpoints = [net.bind(r, "127.0.0.1:0") for r, net in enumerate(nets)]
+    for net in nets:
+        net.connect(endpoints)
+    return nets
+
+
+def _finalize(nets):
+    for net in nets:
+        net.finalize()
+
+
+def test_mailbox_ping_pong():
+    nets = _make_world(2)
+    try:
+        payload = np.arange(64, dtype=np.float32).reshape(8, 8)
+        nets[0].send(Message(src=0, dst=1, type=MsgType.Request_Add,
+                             table_id=7, msg_id=42, data=[payload]))
+        msg = nets[1].recv()
+        assert msg.src == 0 and msg.dst == 1
+        assert msg.type == MsgType.Request_Add
+        assert msg.table_id == 7 and msg.msg_id == 42
+        np.testing.assert_array_equal(msg.data[0], payload)
+
+        reply = msg.create_reply()
+        reply.data = [payload * 2]
+        nets[1].send(reply)
+        back = nets[0].recv()
+        assert back.type == MsgType.Reply_Add
+        np.testing.assert_array_equal(back.data[0], payload * 2)
+    finally:
+        _finalize(nets)
+
+
+def test_raw_channel_is_separate_from_mailbox():
+    nets = _make_world(2)
+    try:
+        nets[0].send(Message(src=0, dst=1, type=MsgType.Request_Get,
+                             data=[np.zeros(3, np.float32)]))
+        nets[0].send_to(1, [np.ones(4, np.int32)])
+        # raw frame must not be consumed by the mailbox and vice versa
+        raw = nets[1].recv_from(0)
+        np.testing.assert_array_equal(raw[0], np.ones(4, np.int32))
+        mail = nets[1].recv()
+        assert mail.type == MsgType.Request_Get
+    finally:
+        _finalize(nets)
+
+
+def test_multi_blob_dtypes_roundtrip():
+    nets = _make_world(2)
+    try:
+        blobs = [np.arange(5, dtype=np.int64),
+                 np.float64([[1.5, -2.5]]),
+                 np.zeros(0, np.float32)]
+        nets[1].send_to(0, blobs)
+        got = nets[0].recv_from(1)
+        for a, b in zip(blobs, got):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+    finally:
+        _finalize(nets)
+
+
+@pytest.mark.parametrize("world,size", [(2, 16), (4, 10), (3, 1), (5, 1024)])
+def test_allreduce_sum(world, size):
+    """MV_Aggregate semantics: every rank receives the elementwise sum
+    (Test/test_allreduce.cpp:13-16: result == MV_Size for all-ones)."""
+    nets = _make_world(world)
+    results = {}
+
+    def run(r):
+        engine = AllreduceEngine(nets[r])
+        data = np.full(size, float(r + 1), np.float32)
+        results[r] = engine.allreduce(data)
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for t in threads:
+        assert not t.is_alive(), "allreduce hung"
+    _finalize(nets)
+    expect = np.full(size, float(sum(range(1, world + 1))), np.float32)
+    for r in range(world):
+        np.testing.assert_allclose(results[r], expect, err_msg=f"rank {r}")
+
+
+def test_allreduce_preserves_shape_and_dtype():
+    nets = _make_world(2)
+    results = {}
+
+    def run(r):
+        results[r] = AllreduceEngine(nets[r]).allreduce(
+            np.ones((3, 5), np.float64) * (r + 1))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    _finalize(nets)
+    for r in range(2):
+        assert results[r].shape == (3, 5)
+        assert results[r].dtype == np.float64
+        np.testing.assert_allclose(results[r], np.full((3, 5), 3.0))
+
+
+def test_allgather_rank_order():
+    nets = _make_world(3)
+    results = {}
+
+    def run(r):
+        results[r] = AllreduceEngine(nets[r]).allgather(
+            np.full(4, float(r), np.float32))
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    _finalize(nets)
+    for r in range(3):
+        parts = results[r]
+        assert len(parts) == 3
+        for i, part in enumerate(parts):
+            np.testing.assert_allclose(part, np.full(4, float(i)))
+
+
+def test_machine_file(tmp_path):
+    from multiverso_tpu.config import FLAGS  # ensure port flag registered
+    from multiverso_tpu.runtime.net import parse_machine_file
+    f = tmp_path / "machines"
+    f.write_text("# cluster\n10.0.0.1:5000\n10.0.0.2\n\n10.0.0.3:7000\n")
+    eps = parse_machine_file(str(f))
+    assert eps[0] == "10.0.0.1:5000"
+    assert eps[1].startswith("10.0.0.2:")
+    assert eps[2] == "10.0.0.3:7000"
+
+
+def test_get_local_ip():
+    ip = get_local_ip()
+    assert ip.count(".") == 3

@@ -1,0 +1,78 @@
+"""Pallas row-kernel tests (interpret mode on the CPU mesh).
+
+The TPU-compiled path is exercised by bench.py on hardware; these verify
+kernel semantics and the caller contracts (group-multiple batches, sentinel
+padding, unique live ids)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from multiverso_tpu.ops.pallas_rows import (ROW_GROUP, gather_rows,
+                                            scatter_add_rows)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_gather_matches_take(rng):
+    table = jnp.asarray(rng.normal(size=(512, 128)).astype(np.float32))
+    ids = jnp.asarray(rng.choice(512, 64, replace=False).astype(np.int32))
+    out = gather_rows(table, ids)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(table)[np.asarray(ids)])
+
+
+def test_gather_repeated_ids_allowed(rng):
+    # reads may repeat rows freely
+    table = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+    ids = jnp.asarray(np.array([3] * ROW_GROUP, np.int32))
+    out = gather_rows(table, ids)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.tile(np.asarray(table)[3], (ROW_GROUP, 1)))
+
+
+def test_scatter_add_unique_ids(rng):
+    table = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32))
+    ids = rng.choice(256, 32, replace=False).astype(np.int32)
+    deltas = rng.normal(size=(32, 128)).astype(np.float32)
+    expect = np.asarray(table).copy()
+    expect[ids] += deltas
+    out = scatter_add_rows(table, jnp.asarray(ids), jnp.asarray(deltas))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+
+def test_scatter_add_sentinel_padding(rng):
+    """Pad slots aim at a sentinel row with zero deltas: live rows update,
+    sentinel row is untouched (zero delta), matching the matrix-table
+    bucket contract."""
+    rows, sentinel = 128, 100
+    table = jnp.zeros((rows, 128), jnp.float32)
+    live = np.array([5, 17], np.int32)
+    ids = np.full(ROW_GROUP, sentinel, np.int32)
+    ids[:2] = live
+    deltas = np.zeros((ROW_GROUP, 128), np.float32)
+    deltas[:2] = 1.0
+    out = np.asarray(scatter_add_rows(table, jnp.asarray(ids),
+                                      jnp.asarray(deltas)))
+    np.testing.assert_allclose(out[live], np.ones((2, 128)))
+    np.testing.assert_allclose(out[sentinel], np.zeros(128))
+    mask = np.ones(rows, bool)
+    mask[live] = False
+    np.testing.assert_allclose(out[mask], 0.0)
+
+
+def test_multiple_groups(rng):
+    batch = ROW_GROUP * 4
+    table = jnp.asarray(rng.normal(size=(1024, 128)).astype(np.float32))
+    ids = rng.choice(1024, batch, replace=False).astype(np.int32)
+    deltas = rng.normal(size=(batch, 128)).astype(np.float32)
+    expect = np.asarray(table).copy()
+    expect[ids] += deltas
+    out = scatter_add_rows(table, jnp.asarray(ids), jnp.asarray(deltas))
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+    got = gather_rows(jnp.asarray(expect), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(got), expect[ids], rtol=1e-6)
