@@ -58,21 +58,35 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
     stack_dev = jax.device_put(stack)
 
     key = jax.random.PRNGKey(0)
-    key, sub = jax.random.split(key)
-    params, loss = step(params, sub, stack_dev, config.lr)  # compile
-    _fetch(params["w_in"][0, :1])
 
-    best = float("inf")
-    for _ in range(3):
-        key, sub = jax.random.split(key)
-        t0 = time.perf_counter()
-        params, loss = step(params, sub, stack_dev, config.lr)
-        _fetch(params["w_in"][0, :1])
-        best = min(best, time.perf_counter() - t0)
+    # slope over pass count: (T(k2 passes) − T(k1 passes)) / Δpasses removes
+    # the tunnel's fixed materialization cost from the throughput figure
+    def run_passes(k):
+        nonlocal params, key
+        best = float("inf")
+        loss = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(k):
+                key, sub = jax.random.split(key)
+                params, loss = step(params, sub, stack_dev, config.lr)
+            _fetch(params["w_in"][0, :1])
+            best = min(best, time.perf_counter() - t0)
+        return best, loss
+
+    run_passes(1)  # compile + warm
+    k1, k2 = 1, 4
+    t1, _ = run_passes(k1)
+    t2, loss = run_passes(k2)
+    per_pass = (t2 - t1) / (k2 - k1)
+    if per_pass <= 0:
+        # noisy measurement (t2 <= t1): fall back to the k2 average rather
+        # than report an absurd slope-derived figure
+        per_pass = t2 / k2
     words = n_blocks * block_tokens
-    # loss is from ONE pass over a 327k-token synthetic corpus — barely off
+    # loss is a few passes over a 327k-token synthetic corpus — barely off
     # init (ln 2 ≈ 0.6931); convergence is covered by tests/test_word2vec.py
-    return words / best, float(loss)
+    return words / per_pass, float(loss)
 
 
 def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
@@ -135,8 +149,11 @@ def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
                 _fetch(f(*args))
                 b = min(b, time.perf_counter() - t0)
             return b
-        # clamp: timer noise on fast backends can invert the two points
-        return max((best(f2) - best(f1)) / (k2 - k1), 1e-9)
+        b1, b2 = best(f1), best(f2)
+        per_op = (b2 - b1) / (k2 - k1)
+        # timer noise on fast backends can invert the two points; fall back
+        # to the k2 average rather than report an absurd slope figure
+        return per_op if per_op > 0 else b2 / k2
 
     data = jnp.zeros((rows, padded_cols), jnp.float32)
     k1, k2 = (100, 1100) if on_tpu else (2, 12)

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-ROW_GROUP = 16  # rows (= concurrent DMAs) per grid step
+ROW_GROUP = 64  # rows (= concurrent DMAs) per grid step; swept on v5e:
+                # 8→83us, 16→49us, 32→32us, 64→28us per 1024x128-row update
 
 
 def _on_tpu() -> bool:
@@ -74,6 +75,9 @@ def _gather_call(table, ids, interpret):
 
 def gather_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
     """``table[ids]`` via overlapped row DMAs. ids: int32, len % ROW_GROUP == 0."""
+    if ids.shape[0] % ROW_GROUP:
+        raise ValueError(
+            f"gather_rows: batch {ids.shape[0]} not a multiple of {ROW_GROUP}")
     return _gather_call(table, ids, not _on_tpu())
 
 
@@ -140,4 +144,7 @@ def scatter_add_rows(table: jax.Array, ids: jax.Array,
                      deltas: jax.Array) -> jax.Array:
     """In-place ``table.at[ids].add(deltas)`` for unique live ids; the input
     table buffer is donated."""
+    if ids.shape[0] % ROW_GROUP:
+        raise ValueError(
+            f"scatter_add_rows: batch {ids.shape[0]} not a multiple of {ROW_GROUP}")
     return _scatter_add_call(table, ids, deltas, not _on_tpu())

@@ -134,8 +134,9 @@ class MatrixServer(ServerTable):
         """Pad (ids, values) to a power-of-two bucket aimed at the sentinel
         scratch row so jit traces are shape-stable."""
         n = len(ids)
-        # min bucket 16 = pallas ROW_GROUP (batch must be a group multiple)
-        bucket = max(_next_pow2(n), 16)
+        # min bucket = pallas ROW_GROUP (batch must be a group multiple)
+        from multiverso_tpu.ops.pallas_rows import ROW_GROUP
+        bucket = max(_next_pow2(n), ROW_GROUP)
         pad = bucket - n
         ids_p = np.concatenate([ids, np.full(pad, self.sentinel_row, dtype=ids.dtype)])
         vals_p = None

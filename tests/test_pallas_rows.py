@@ -20,7 +20,7 @@ def rng():
 
 def test_gather_matches_take(rng):
     table = jnp.asarray(rng.normal(size=(512, 128)).astype(np.float32))
-    ids = jnp.asarray(rng.choice(512, 64, replace=False).astype(np.int32))
+    ids = jnp.asarray(rng.choice(512, ROW_GROUP, replace=False).astype(np.int32))
     out = gather_rows(table, ids)
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(table)[np.asarray(ids)])
@@ -37,8 +37,8 @@ def test_gather_repeated_ids_allowed(rng):
 
 def test_scatter_add_unique_ids(rng):
     table = jnp.asarray(rng.normal(size=(256, 128)).astype(np.float32))
-    ids = rng.choice(256, 32, replace=False).astype(np.int32)
-    deltas = rng.normal(size=(32, 128)).astype(np.float32)
+    ids = rng.choice(256, ROW_GROUP, replace=False).astype(np.int32)
+    deltas = rng.normal(size=(ROW_GROUP, 128)).astype(np.float32)
     expect = np.asarray(table).copy()
     expect[ids] += deltas
     out = scatter_add_rows(table, jnp.asarray(ids), jnp.asarray(deltas))
@@ -76,3 +76,21 @@ def test_multiple_groups(rng):
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
     got = gather_rows(jnp.asarray(expect), jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(got), expect[ids], rtol=1e-6)
+
+
+def test_scatter_mean_step_dedup(rng):
+    from multiverso_tpu.ops.scatter import scatter_mean_step
+
+    rows, dim, sentinel = 64, 128, 63
+    table = jnp.asarray(rng.normal(size=(rows, dim)).astype(np.float32))
+    # duplicates: row 5 twice, row 9 once
+    ids = jnp.asarray(np.array([5, 9, 5], np.int32))
+    grads = jnp.asarray(np.stack([np.full(dim, 2.0), np.full(dim, 4.0),
+                                  np.full(dim, 6.0)]).astype(np.float32))
+    out = np.asarray(scatter_mean_step(table, ids, grads, 0.5, sentinel))
+    ref = np.asarray(table).copy()
+    ref[5] -= 0.5 * 4.0   # mean(2, 6)
+    ref[9] -= 0.5 * 4.0
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # sentinel row untouched
+    np.testing.assert_allclose(out[sentinel], np.asarray(table)[sentinel])
