@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Logistic regression, local and PS-mode (the reference's
+``Applications/LogisticRegression`` driver shape).
+
+Run:  python examples/logreg_train.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.logreg import LogReg, LogRegConfig, PSLogReg
+
+
+def make_data(rng, w, n=2048, d=30):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X @ w > 0).astype(np.int32)
+    return X, y
+
+
+def main():
+    rng = np.random.default_rng(0)
+    true_w = rng.normal(size=30).astype(np.float32)
+    X, y = make_data(rng, true_w)
+    Xte, yte = make_data(rng, true_w, n=512)
+
+    # local mode (reference `Model`)
+    config = LogRegConfig(input_size=30, objective="sigmoid", lr=0.1,
+                          regular="l2", regular_coef=1e-4)
+    model = LogReg(config)
+    for epoch in range(30):
+        for i in range(0, len(X), 256):
+            model.update({"x": X[i:i + 256], "y": y[i:i + 256]})
+    print(f"local  sigmoid accuracy: {model.test({'x': Xte, 'y': yte}):.3f}")
+
+    # PS mode with sync-frequency pipeline (reference `PSModel`)
+    mv.init()
+    ps_config = LogRegConfig(input_size=30, objective="sigmoid", lr=0.1,
+                             use_ps=True, sync_frequency=4, pipeline=True)
+    ps_model = PSLogReg(ps_config)
+    for epoch in range(30):
+        for i in range(0, len(X), 256):
+            ps_model.update({"x": X[i:i + 256], "y": y[i:i + 256]})
+    ps_model.finish()
+    print(f"PS     sigmoid accuracy: {ps_model.test({'x': Xte, 'y': yte}):.3f}")
+    mv.shutdown()
+
+
+if __name__ == "__main__":
+    main()
