@@ -3,11 +3,12 @@
 
 Headline: word2vec skip-gram+NS training throughput (words/sec/chip) on the
 HBM-resident block-mode path — the BASELINE.md north-star metric
-("WordEmbedding words/sec/chip"). ``vs_baseline`` compares against 100k
-words/sec, the canonical per-thread rate of the reference's C hot loop
-(its only published form is the live "Words/thread/second: Xk" log,
-``Applications/WordEmbedding/src/trainer.cpp:44-48``; 100k/thread is the
-standard figure for word2vec-style CPU loops on one modern core).
+("WordEmbedding words/sec/chip"). The reference published NO words/sec
+figure (BASELINE.md: its only form is the live "Words/thread/second" log
+line), so the headline value is reported absolute. ``vs_baseline`` is the
+one quantified target BASELINE.json does state — MatrixTable row-Add p50
+latency < 50 µs — expressed as target/measured (>1 = beating it); see
+``vs_baseline_note`` in the output.
 
 Extra fields: MatrixTable row Add/Get device-path timings at the reference
 perf-harness shape (1M×50 fp32, ``Test/test_matrix_perf.cpp:32-45``) plus
@@ -85,8 +86,14 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
         per_pass = t2 / k2
     words = n_blocks * block_tokens
     # loss is a few passes over a 327k-token synthetic corpus — barely off
-    # init (ln 2 ≈ 0.6931); convergence is covered by tests/test_word2vec.py
-    return words / per_pass, float(loss)
+    # init (ln 2 ≈ 0.6931); convergence is covered by tests/test_word2vec.py.
+    # A non-finite loss means the run diverged: refuse to report throughput.
+    loss = float(loss)
+    final_w = _fetch(params["w_in"][:2, :2])
+    if not (np.isfinite(loss) and np.isfinite(final_w).all()):
+        raise RuntimeError(
+            f"word2vec bench diverged (loss={loss}); not reporting throughput")
+    return words / per_pass, loss
 
 
 def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
@@ -196,7 +203,12 @@ def main():
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
         "unit": "words/s",
-        "vs_baseline": round(words_per_sec / 100_000.0, 2),
+        # the only quantified target in BASELINE.json: matrix row-Add
+        # p50 < 50us; the reference published no words/sec figure
+        "vs_baseline": round(50.0 / matrix["matrix_add_p50_us"], 2),
+        "vs_baseline_note": ("ratio = BASELINE.json matrix-add p50 target "
+                             "(50us) / measured p50; no published words/sec "
+                             "baseline exists"),
         "final_loss": round(final_loss, 4),
         **matrix,
     }
