@@ -123,6 +123,34 @@ def aggregate(data: np.ndarray) -> np.ndarray:
     return Zoo.instance().aggregate(data)
 
 
+# -- remote table serving (cross-process PS) ---------------------------------
+# The reference's core product: workers in OTHER processes reach tables over
+# the network (worker actor → communicator → net → server). Here the
+# mesh-owning process calls serve(); off-mesh clients call remote_connect()
+# and get worker-table proxies with identical get/add semantics.
+
+def serve(endpoint: str = "127.0.0.1:0") -> str:
+    """Start serving this process's tables to remote clients; returns the
+    dialable endpoint (pass port 0 for ephemeral). Set the
+    ``remote_workers`` flag at init so BSP clocks and per-worker updater
+    state cover the remote clients."""
+    zoo = Zoo.instance()
+    if not zoo.started or zoo.server is None:
+        log.fatal("serve: init() the PS runtime first (not available in ma mode)")
+    if zoo.remote_server is None:
+        from multiverso_tpu.runtime.remote import RemoteServer
+        zoo.remote_server = RemoteServer(zoo)
+        return zoo.remote_server.serve(endpoint)
+    return zoo.remote_server.endpoint
+
+
+def remote_connect(endpoint: str, timeout: float = 30.0):
+    """Connect to a serving process; returns a RemoteClient whose
+    ``.table(table_id)`` / ``.tables()`` give worker-table proxies."""
+    from multiverso_tpu.runtime.remote import RemoteClient
+    return RemoteClient(endpoint, timeout=timeout)
+
+
 # -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------
 # External (off-mesh) hosts — the reference's CNTK/C# deployment shape
 # (include/multiverso/multiverso.h:60-65, ZMQ Bind/Connect mode) — drive the

@@ -19,19 +19,43 @@ Contracts (enforced by the caller, `tables.matrix_table.MatrixServer`):
   two ≥ the group).
 
 Off-TPU (the virtual-CPU test mesh) the kernels run in interpreter mode.
+
+Optimization record (measured on the bench chip, v5e single-core, 1024-row
+x 128-col update on a 1M-row table, scan-slope timing):
+
+* group-size sweep: 8→83us, 16→49us, 32→32us, 64→26.4us, 128→27.2us;
+  256 exceeds the semaphore-flag memory (sflag 2KB). The 64-group asymptote
+  is the per-row DMA issue cost (~13ns/descriptor on the scalar core), not
+  transfer latency.
+* software pipelining (double-buffered scratch, group g+1 reads overlapped
+  with group g writes): 35.8us — SLOWER than the simple kernel. Two causes:
+  the dynamic buffer indexing taxes every descriptor, and the overlap
+  window (one group's processing, <1us) barely covers a write's latency.
+  A read-only variant measures 18.2us vs 26.4us read+write, i.e. the write
+  phase already overlaps ~70% behind the next group's reads via the DMA
+  engine's own queueing. The simple kernel is kept.
+* remaining headroom would need fewer/larger descriptors (rows are 512B —
+  per-descriptor cost dominates); with arbitrary row ids there is no
+  contiguity to merge, so this is the v5e floor for this op shape.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-ROW_GROUP = 64  # rows (= concurrent DMAs) per grid step; swept on v5e:
-                # 8→83us, 16→49us, 32→32us, 64→28us per 1024x128-row update
+# rows (= concurrent DMAs) per grid step; env-overridable for sweeps —
+# see the optimization record above for the measured sweep
+ROW_GROUP = int(os.environ.get("MVTPU_ROW_GROUP", "64"))
+if ROW_GROUP <= 0 or ROW_GROUP & (ROW_GROUP - 1):
+    # bucket sizes are powers of two >= the group; a non-power-of-two group
+    # would silently violate the batch-multiple contract and drop updates
+    raise ValueError(f"MVTPU_ROW_GROUP must be a power of two, got {ROW_GROUP}")
 
 
 def _on_tpu() -> bool:

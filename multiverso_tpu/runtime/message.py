@@ -30,11 +30,13 @@ class MsgType(enum.IntEnum):
     # worker-bound replies (negative)
     Reply_Get = -1
     Reply_Add = -2
+    Reply_Error = -5  # request failed server-side / peer connection lost
     # control plane (>= 32 request, <= -32 reply)
     Control_Barrier = 33
     Control_Reply_Barrier = -33
     Control_Register = 34
     Control_Reply_Register = -34
+    Control_Deregister = 35  # graceful client close frees its worker slot
 
     @property
     def is_server_bound(self) -> bool:

@@ -101,6 +101,7 @@ class TcpNet:
         self._conns: Dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
         self._send_locks: Dict[int, threading.Lock] = {}
+        self._sock_locks: Dict[socket.socket, threading.Lock] = {}
         self._mailbox: MtQueue = MtQueue()
         self._raw: Dict[int, MtQueue] = {}
         self._accept_thread: Optional[threading.Thread] = None
@@ -169,35 +170,60 @@ class TcpNet:
         return self._send(msg, channel=1)
 
     def recv(self) -> Optional[Message]:
-        """Pop the next mailbox message (blocks; None on shutdown)."""
-        return self._mailbox.pop()
+        """Pop the next mailbox message (blocks; None on shutdown). Raises
+        ConnectionError when a peer connection died while the transport is
+        live (fail-fast instead of hanging waiters)."""
+        msg = self._mailbox.pop()
+        if (msg is not None and msg.type == MsgType.Reply_Error
+                and msg.src == -1):
+            raise ConnectionError("net: peer connection lost")
+        return msg
 
     def recv_from(self, rank: int) -> Optional[List[np.ndarray]]:
         msg = self._raw[rank].pop()
-        return None if msg is None else msg.data
+        if msg is None:
+            return None
+        if msg.type == MsgType.Reply_Error and msg.src == -1:
+            raise ConnectionError(
+                "net: peer connection lost while waiting for data")
+        return msg.data
 
     def send_recv(self, dst: int, blobs: List[np.ndarray],
                   src: int) -> Optional[List[np.ndarray]]:
         self.send_to(dst, blobs)
         return self.recv_from(src)
 
+    def send_via(self, conn: socket.socket, msg: Message,
+                 channel: int = 0) -> int:
+        """Send over an explicit connection — the reply path for peers that
+        never bound a listener (remote table clients): the server answers
+        over the socket the request arrived on (``msg._conn``)."""
+        with self._conn_lock:
+            lock = self._sock_locks.setdefault(conn, threading.Lock())
+        frame = self._frame(msg, channel)
+        with lock:
+            conn.sendall(frame)
+        return len(frame)
+
     # -- internals ----------------------------------------------------------
-    def _send(self, msg: Message, channel: int) -> int:
-        sock = self._socket_for(msg.dst)
+    @staticmethod
+    def _frame(msg: Message, channel: int) -> bytes:
         parts = [b""]  # placeholder for header
-        total = 0
         for arr in msg.data:
             head, payload = _pack_blob(np.asarray(arr))
             parts.append(head)
             parts.append(payload)
-            total += len(payload)
         parts[0] = _HEADER.pack(_MAGIC, channel, msg.src, msg.dst,
                                 int(msg.type), msg.table_id, msg.msg_id,
                                 len(msg.data))
-        frame = b"".join(parts)
+        return b"".join(parts)
+
+    def _send(self, msg: Message, channel: int) -> int:
+        sock = self._socket_for(msg.dst)
+        frame = self._frame(msg, channel)
         with self._send_locks.setdefault(msg.dst, threading.Lock()):
             sock.sendall(frame)
-        return total
+        return len(frame)
 
     def _socket_for(self, rank: int) -> socket.socket:
         with self._conn_lock:
@@ -216,6 +242,11 @@ class TcpNet:
                 sock.close()
                 return existing
             self._conns[rank] = sock
+        self._active = True
+        # dialed sockets also receive: peers without a listener of their own
+        # (remote table clients) get replies back over this connection
+        threading.Thread(target=self._recv_loop, args=(sock,), daemon=True,
+                         name=f"mvtpu-net-recv-dial-{self.rank}").start()
         return sock
 
     def _accept_loop(self) -> None:
@@ -232,6 +263,7 @@ class TcpNet:
                              name=f"mvtpu-net-recv-{self.rank}").start()
 
     def _recv_loop(self, conn: socket.socket) -> None:
+        srcs_seen: set = set()
         try:
             while self._active:
                 head = _read_exact(conn, _HEADER.size)
@@ -239,7 +271,9 @@ class TcpNet:
                     _HEADER.unpack(head))
                 if magic != _MAGIC:
                     log.error("net: bad frame magic %x", magic)
+                    self._drop_conn(conn, srcs_seen)
                     return
+                srcs_seen.add(src)
                 blobs = []
                 for _ in range(nblobs):
                     bh = _read_exact(conn, _BLOB.size)
@@ -252,12 +286,41 @@ class TcpNet:
                     ).reshape(shape).copy())
                 msg = Message(src=src, dst=dst, type=MsgType(mtype),
                               table_id=table_id, msg_id=msg_id, data=blobs)
+                msg._conn = conn  # reply path for listener-less peers
                 if channel == 1:
                     self._raw.setdefault(src, MtQueue()).push(msg)
                 else:
                     self._mailbox.push(msg)
         except (ConnectionError, OSError):
+            self._drop_conn(conn, srcs_seen)
             return
+
+    def _drop_conn(self, conn: socket.socket, srcs_seen: set) -> None:
+        """A connection died: prune its bookkeeping and — if the transport
+        is still live — push a peer-lost sentinel so blocked receivers
+        (mid-allreduce, pending table replies) fail fast instead of hanging
+        until finalize(). Only the dead peer's raw queues are poisoned."""
+        with self._conn_lock:
+            self._sock_locks.pop(conn, None)
+            if conn in self._accepted:
+                self._accepted.remove(conn)
+            for rank, sock in list(self._conns.items()):
+                if sock is conn:
+                    del self._conns[rank]
+                    srcs_seen = srcs_seen | {rank}
+        try:
+            conn.close()
+        except OSError:
+            pass
+        if not self._active:
+            return  # normal shutdown; finalize() exits the queues
+        sentinel = Message(src=-1, dst=self.rank, type=MsgType.Reply_Error)
+        sentinel._conn = conn
+        self._mailbox.push(sentinel)
+        for src in srcs_seen:
+            q = self._raw.get(src)
+            if q is not None:
+                q.push(sentinel)
 
 
 class AllreduceEngine:

@@ -1,0 +1,361 @@
+"""Remote table serving — the cross-process parameter-server path.
+
+Reference capability (not copied): a worker in ANY process reaches tables via
+worker actor → Communicator → network → Server actor, with the reply
+retracing the path (``src/worker.cpp:30-76``, ``src/communicator.cpp:69-105``,
+``src/server.cpp:36-58``); external hosts registered through the Controller
+(``src/controller.cpp:38-80``).
+
+TPU-era design: ONE process owns the device mesh and runs the dispatcher
+(:mod:`multiverso_tpu.runtime.server`); any other process is an off-mesh
+client. :class:`RemoteServer` is the net↔dispatcher bridge — a pump thread
+pops table-request frames from the TCP mailbox, decodes them into the same
+request structures local workers enqueue, and attaches a completion that
+frames the reply back over the socket the request arrived on (clients never
+bind a listener). :class:`RemoteClient` registers (gets a worker id + the
+table directory), then hands out worker-table proxies that share ALL client
+shaping code with the in-process workers — only the channel differs — so the
+BSP clocks, per-worker updater state, and option envelopes behave
+identically across the wire.
+
+Payloads ride the :mod:`multiverso_tpu.runtime.wire` codec; float32 arrays
+are SparseFilter-compressed when the ``wire_compression`` flag is on and the
+sparse form is smaller (the reference applied SparseFilter on exactly these
+host hops, ``src/table/sparse_matrix_table.cpp:147-153``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from multiverso_tpu import config, log
+from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
+from multiverso_tpu.runtime.net import TcpNet
+from multiverso_tpu.runtime import wire
+from multiverso_tpu.tables.array_table import ArrayWorker
+from multiverso_tpu.tables.base import Completion, WorkerTable
+from multiverso_tpu.tables.kv_table import KVWorker
+from multiverso_tpu.tables.matrix_table import MatrixWorker
+
+config.define_bool("wire_compression", True,
+                   "SparseFilter-compress float32 payloads on host hops "
+                   "when the sparse form is smaller")
+
+
+# -- server side -------------------------------------------------------------
+
+class _NetCompletion:
+    """Dispatcher completion that frames the result back over the wire."""
+
+    __slots__ = ("_net", "_conn", "_template", "_compress")
+
+    def __init__(self, net: TcpNet, conn, template: Message,
+                 compress: bool) -> None:
+        self._net = net
+        self._conn = conn
+        self._template = template
+        self._compress = compress
+
+    def _reply(self, msg_type: MsgType, payload: Any) -> None:
+        t = self._template
+        msg = Message(src=t.dst, dst=t.src, type=msg_type,
+                      table_id=t.table_id, msg_id=t.msg_id,
+                      data=wire.encode(payload, compress=self._compress))
+        try:
+            self._net.send_via(self._conn, msg)
+        except OSError as exc:
+            log.error("remote: reply to worker %d failed: %r", t.src, exc)
+
+    def done(self, result: Any) -> None:
+        reply_type = (MsgType.Reply_Get
+                      if self._template.type == MsgType.Request_Get
+                      else MsgType.Reply_Add)
+        self._reply(reply_type, result)
+
+    def fail(self, error: BaseException) -> None:
+        self._reply(MsgType.Reply_Error, repr(error))
+
+
+class RemoteServer:
+    """Serves this process's tables to off-mesh clients over TCP."""
+
+    def __init__(self, zoo) -> None:
+        self._zoo = zoo
+        self._net = TcpNet()
+        self._thread: Optional[threading.Thread] = None
+        self._wid_lock = threading.Lock()
+        self._next_remote = 0
+        self._free_slots: List[int] = []  # recycled by Control_Deregister
+        self.endpoint: Optional[str] = None
+
+    def serve(self, endpoint: str = "127.0.0.1:0") -> str:
+        """Bind + start the pump; returns the dialable endpoint."""
+        self.endpoint = self._net.bind(0, endpoint)
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="mv-remote-serve")
+        self._thread.start()
+        return self.endpoint
+
+    def stop(self) -> None:
+        self._net.finalize()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- pump ---------------------------------------------------------------
+    def _pump(self) -> None:
+        compress = bool(config.get_flag("wire_compression"))
+        while True:
+            try:
+                msg = self._net.recv()
+            except ConnectionError:
+                continue  # a client connection died; its waiters are remote
+            if msg is None:
+                return
+            try:
+                self._handle(msg, compress)
+            except Exception as exc:  # noqa: BLE001 — keep serving
+                log.error("remote server: error on %s: %r", msg.type, exc)
+                _NetCompletion(self._net, msg._conn, msg, False).fail(exc)
+
+    def _handle(self, msg: Message, compress: bool) -> None:
+        if msg.type == MsgType.Control_Register:
+            self._register_client(msg)
+            return
+        if msg.type == MsgType.Control_Deregister:
+            # Graceful close recycles the slot — async server only. The
+            # sync server's per-worker clocks/finished flags are positional
+            # history a newcomer must not inherit, so BSP keeps the
+            # reference's static-membership contract (a departed worker's
+            # slot stays retired; crashed clients are never reclaimed).
+            from multiverso_tpu.runtime.server import SyncServer
+            if not isinstance(self._zoo.server, SyncServer):
+                with self._wid_lock:
+                    self._free_slots.append(int(msg.src))
+            return
+        if msg.type == MsgType.Server_Finish_Train:
+            self._zoo.server.send(Message(
+                src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
+                msg_id=msg.msg_id))
+            return
+        if msg.type not in (MsgType.Request_Get, MsgType.Request_Add):
+            log.error("remote server: unhandled frame type %s", msg.type)
+            return
+        request = wire.decode(msg.data)
+        completion = _NetCompletion(self._net, msg._conn, msg, compress)
+        self._zoo.server.send(Message(
+            src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
+            msg_id=msg.msg_id, data=[request, completion]))
+
+    def _register_client(self, msg: Message) -> None:
+        base = self._zoo.num_workers - self._zoo.remote_workers
+        with self._wid_lock:
+            if self._free_slots:
+                worker_id = self._free_slots.pop()
+            elif self._next_remote >= self._zoo.remote_workers:
+                # refuse: an out-of-range worker id would alias slot-0
+                # per-worker state and bypass the BSP clocks
+                reply = Message(src=msg.dst, dst=msg.src,
+                                type=MsgType.Control_Reply_Register,
+                                msg_id=msg.msg_id,
+                                data=wire.encode({"error": (
+                                    f"all {self._zoo.remote_workers} remote "
+                                    "worker slots are taken (raise the "
+                                    "remote_workers flag at init)")}))
+                self._net.send_via(msg._conn, reply)
+                return
+            else:
+                worker_id = base + self._next_remote
+                self._next_remote += 1
+        directory = []
+        # snapshot: create_table on the main thread mutates the dict
+        for table_id, table in list(self._zoo.server._tables.items()):
+            spec = table.remote_spec()
+            if spec is not None:
+                directory.append({"table_id": table_id, **spec})
+        reply = Message(src=msg.dst, dst=msg.src,
+                        type=MsgType.Control_Reply_Register,
+                        msg_id=msg.msg_id,
+                        data=wire.encode({"worker_id": worker_id,
+                                          "num_workers": self._zoo.num_workers,
+                                          "tables": directory}))
+        self._net.send_via(msg._conn, reply)
+
+
+# -- client side -------------------------------------------------------------
+
+class RemoteChannel:
+    """WorkerTable request channel that frames requests over TCP."""
+
+    def __init__(self, client: "RemoteClient") -> None:
+        self._client = client
+
+    def worker_id(self) -> int:
+        return self._client.worker_id
+
+    def submit(self, table_id: int, msg_type: MsgType, request: Any,
+               msg_id: int, completion: Completion) -> None:
+        self._client._send(table_id, msg_type, request, msg_id, completion)
+
+    def post(self, table_id: int, msg_type: MsgType) -> None:
+        self._client._send(table_id, msg_type, None, next_msg_id(), None)
+
+
+class RemoteClient:
+    """Off-mesh table client: register → worker id + table directory."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+        self._net = TcpNet()
+        self._net.rank = -1
+        self._net.connect([endpoint])
+        self._pending: Dict[int, Completion] = {}
+        self._lock = threading.Lock()
+        self._compress = bool(config.get_flag("wire_compression"))
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True, name="mv-remote-client")
+        self._pump_thread.start()
+        self.worker_id = -1
+        self.directory: List[Dict[str, Any]] = []
+        self.num_workers = 0
+        self._closed = False
+        self._register(timeout)
+        self._channel = RemoteChannel(self)
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._net.send(Message(src=self.worker_id, dst=0,
+                                   type=MsgType.Control_Deregister,
+                                   msg_id=next_msg_id()))
+        except OSError:
+            pass  # server already gone; slot stays leased (static membership)
+        self._net.finalize()
+
+    def _register(self, timeout: float) -> None:
+        msg_id = next_msg_id()
+        completion = Completion()
+        with self._lock:
+            self._pending[msg_id] = completion
+        self._net.send(Message(src=-1, dst=0, type=MsgType.Control_Register,
+                               msg_id=msg_id, data=wire.encode(None)))
+        info = completion.wait(timeout)
+        if "error" in info:
+            self._net.finalize()
+            raise RuntimeError(f"remote registration refused: {info['error']}")
+        self.worker_id = int(info["worker_id"])
+        self.num_workers = int(info["num_workers"])
+        self.directory = info["tables"]
+
+    # -- request path --------------------------------------------------------
+    def _send(self, table_id: int, msg_type: MsgType, request: Any,
+              msg_id: int, completion: Optional[Completion]) -> None:
+        if completion is not None:
+            with self._lock:
+                self._pending[msg_id] = completion
+        data = [] if request is None and msg_type not in (
+            MsgType.Request_Get, MsgType.Request_Add) else wire.encode(
+                request, compress=self._compress)
+        self._net.send(Message(src=self.worker_id, dst=0, type=msg_type,
+                               table_id=table_id, msg_id=msg_id, data=data))
+
+    def _pump(self) -> None:
+        while True:
+            try:
+                msg = self._net.recv()
+            except ConnectionError:
+                self._fail_all(ConnectionError("server connection lost"))
+                continue
+            if msg is None:
+                self._fail_all(ConnectionError("remote client shut down"))
+                return
+            with self._lock:
+                completion = self._pending.pop(msg.msg_id, None)
+            if completion is None:
+                continue
+            try:
+                if msg.type == MsgType.Reply_Error:
+                    completion.fail(RuntimeError(
+                        f"server-side failure: {wire.decode(msg.data)}"))
+                elif msg.type == MsgType.Reply_Add:
+                    completion.done(None)
+                else:
+                    completion.done(wire.decode(msg.data))
+            except Exception as exc:  # noqa: BLE001 — a malformed reply must
+                # fail its waiter, not kill the pump (which would hang every
+                # later request forever)
+                completion.fail(exc)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for completion in pending:
+            completion.fail(exc)
+
+    # -- table proxies -------------------------------------------------------
+    def table(self, table_id: int) -> WorkerTable:
+        """Build the worker proxy matching the server table's directory
+        entry. Proxies share all shaping code with the in-process workers."""
+        spec = next((s for s in self.directory
+                     if s["table_id"] == table_id), None)
+        if spec is None:
+            raise KeyError(f"no remotable table with id {table_id}; "
+                           f"directory: {self.directory}")
+        kind = spec["kind"]
+        if kind == "array":
+            return _RemoteArrayWorker(spec, table_id, self._channel)
+        if kind == "matrix":
+            return _RemoteMatrixWorker(spec, table_id, self._channel)
+        if kind == "kv":
+            return _RemoteKVWorker(spec, table_id, self._channel)
+        raise KeyError(f"unknown remote table kind {kind!r}")
+
+    def tables(self) -> List[WorkerTable]:
+        return [self.table(s["table_id"]) for s in self.directory]
+
+
+class _RemoteArrayWorker(ArrayWorker):
+    """ArrayWorker shaping over the wire (no server construction)."""
+
+    def __init__(self, spec, table_id: int, channel: RemoteChannel) -> None:
+        WorkerTable.__init__(self, channel=channel)
+        self.table_id = table_id
+        self.size = int(spec["size"])
+        self.dtype = np.dtype(spec["dtype"])
+
+    def get_device(self):
+        raise RuntimeError("get_device() needs mesh residency; remote "
+                           "clients are off-mesh — use get()")
+
+
+class _RemoteMatrixWorker(MatrixWorker):
+    """MatrixWorker shaping (row buckets, sparse cache, option defaults)
+    over the wire."""
+
+    def __init__(self, spec, table_id: int, channel: RemoteChannel) -> None:
+        WorkerTable.__init__(self, channel=channel)
+        self.table_id = table_id
+        self.num_row = int(spec["num_row"])
+        self.num_col = int(spec["num_col"])
+        self.dtype = np.dtype(spec["dtype"])
+        self.is_sparse = bool(spec.get("is_sparse", False))
+        self._cache = (np.zeros((self.num_row, self.num_col), self.dtype)
+                       if self.is_sparse else None)
+
+    def get_device(self):
+        raise RuntimeError("get_device() needs mesh residency; remote "
+                           "clients are off-mesh — use get()")
+
+
+class _RemoteKVWorker(KVWorker):
+    def __init__(self, spec, table_id: int, channel: RemoteChannel) -> None:
+        WorkerTable.__init__(self, channel=channel)
+        self.table_id = table_id
+        self.value_dtype = np.dtype(spec["dtype"])
+        self._raw: Dict[int, Any] = {}

@@ -138,9 +138,17 @@ class SyncServer(Server):
         vals = [a for a, f in zip(self._add_clock[table_id], self._finished) if not f]
         return min(vals) if vals else 1 << 60
 
+    def _is_admin(self, worker: int) -> bool:
+        """Administrative access (no worker context — e.g. checkpoint reads
+        on a server-only node, worker id -1) bypasses the clocks."""
+        return not 0 <= worker < self.num_workers
+
     def _process_add(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
+        if self._is_admin(worker):
+            super()._process_add(msg)
+            return
         round_ = self._add_clock[tid][worker] + 1
         # round-r Adds wait until every worker has finished its round-(r-1) Gets
         if self._min_gets(tid) >= round_ - 1:
@@ -155,6 +163,9 @@ class SyncServer(Server):
     def _process_get(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
+        if self._is_admin(worker):
+            super()._process_get(msg)
+            return
         round_ = self._get_clock[tid][worker] + 1
         # round-i Gets wait until every worker's round-i Add is applied
         if self._min_adds(tid) >= round_:
@@ -167,6 +178,8 @@ class SyncServer(Server):
             self._pending_get[tid].append(msg)
 
     def _process_finish_train(self, msg: Message) -> None:
+        if self._is_admin(msg.src):
+            return
         self._finished[msg.src] = True
         for tid in list(self._tables):
             self._drain(tid)

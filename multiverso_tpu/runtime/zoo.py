@@ -6,15 +6,18 @@ barrier (``include/multiverso/zoo.h:19-85``, ``src/zoo.cpp``). Rank-0 ran a
 Controller actor assigning worker/server ids and broadcasting membership
 (``src/controller.cpp:38-80``).
 
-TPU-native re-design: on an SPMD substrate membership is static and known at
-init (JAX process index/count + the device mesh), so the register protocol
+TPU-native re-design: ONE process owns the mesh and the dispatcher; its
+membership is static and known at init, so the register protocol
 degenerates to arithmetic — the Controller actor is subsumed by
-:meth:`Zoo._assign_ids`, and the barrier maps to a host-thread barrier within
-the process plus ``multihost_utils.sync_global_devices`` across processes.
-The *logical worker* concept is kept first-class: the reference scaled
-workers by adding MPI ranks; here a process hosts ``local_workers`` worker
-contexts (threads) and multi-process deployments multiply that by
-``jax.process_count()``. Server "ranks" are device shards of the table mesh.
+:meth:`Zoo._assign_ids`. The *logical worker* concept is kept first-class:
+the reference scaled workers by adding MPI ranks; here a process hosts
+``local_workers`` worker contexts (threads) plus ``remote_workers`` off-mesh
+clients that register over the wire (:mod:`multiverso_tpu.runtime.remote`,
+the reference's RegisterNode path). Server "ranks" are device shards of the
+table mesh. Multi-process JAX runtimes are rejected at init: a host-thread
+dispatcher issuing jitted ops on globally-sharded arrays is not
+collective-safe across processes, so scaling across hosts is by off-mesh
+workers, matching the reference's worker/server process split.
 """
 
 from __future__ import annotations
@@ -31,6 +34,9 @@ from multiverso_tpu.runtime.node import Node, Role
 from multiverso_tpu.runtime.server import Server, make_server
 
 config.define_int("local_workers", 1, "logical worker contexts hosted by this process")
+config.define_int("remote_workers", 0,
+                  "expected off-mesh worker clients served over the wire "
+                  "(mv.serve); they get worker ids after all local contexts")
 
 _thread_local = threading.local()
 
@@ -46,7 +52,9 @@ class Zoo:
         self.node = Node()
         self.mesh: Optional[jax.sharding.Mesh] = None
         self.server: Optional[Server] = None
+        self.remote_server: Optional[Any] = None  # runtime.remote.RemoteServer
         self._local_workers = 1
+        self._remote_workers = 0
         self._process_index = 0
         self._process_count = 1
         self._barrier: Optional[threading.Barrier] = None
@@ -75,9 +83,21 @@ class Zoo:
         remaining = config.parse_cmd_flags(list(argv) if argv else [])
         self._process_index = jax.process_index()
         self._process_count = jax.process_count()
+        if self._process_count > 1:
+            # The PS contract is ONE mesh-owning process: the dispatcher
+            # thread issues jitted ops on sharded arrays, which is not
+            # collective-safe across JAX processes. Scale across hosts with
+            # off-mesh workers instead: mv.serve() here, mv.remote_connect()
+            # there (the reference's multi-rank shape), or raw-net
+            # allreduce for ma-style deployments.
+            log.fatal(
+                "multi-process JAX runtimes are unsupported for the PS "
+                "path (process_count=%d); attach off-mesh workers via "
+                "mv.serve()/mv.remote_connect()", self._process_count)
         self.node.rank = self._process_index
         self.node.role = Role.from_string(config.get_flag("ps_role"))
         self._local_workers = max(1, config.get_flag("local_workers"))
+        self._remote_workers = max(0, config.get_flag("remote_workers"))
         self._assign_ids()
 
         shape = mesh_lib.parse_mesh_shape(config.get_flag("mesh_shape"))
@@ -101,6 +121,9 @@ class Zoo:
         if not self._started:
             return
         self.process_barrier()
+        if self.remote_server is not None:
+            self.remote_server.stop()
+            self.remote_server = None
         if self.server is not None:
             self.server.stop()
             self.server = None
@@ -130,7 +153,15 @@ class Zoo:
 
     @property
     def num_workers(self) -> int:
-        return self._process_count * self._local_workers
+        """Local worker contexts (only when this node carries the worker
+        role — a pure-server node hosts none) plus expected remote clients."""
+        local = (self._process_count * self._local_workers
+                 if self.node.is_worker else 0)
+        return local + self._remote_workers
+
+    @property
+    def remote_workers(self) -> int:
+        return self._remote_workers
 
     @property
     def num_servers(self) -> int:
@@ -142,7 +173,12 @@ class Zoo:
         return self._local_workers
 
     def current_worker_id(self) -> int:
-        """Global worker id of the calling thread's worker context."""
+        """Global worker id of the calling thread's worker context. On a
+        server-only node there is no worker context: returns -1, which the
+        consistency machinery treats as administrative (un-clocked) access —
+        e.g. checkpoint reads on a serving node."""
+        if not self.node.is_worker:
+            return -1
         local = getattr(_thread_local, "worker_slot", 0)
         return self.rank * self._local_workers + local
 
@@ -160,23 +196,17 @@ class Zoo:
 
     # -- barrier -----------------------------------------------------------
     def barrier(self) -> None:
-        """Blocks until every worker context (all processes) arrives. Must be
-        called from every local worker context when ``local_workers > 1``."""
+        """Blocks until every local worker context arrives. Must be called
+        from every local worker context when ``local_workers > 1``.
+        (Single-process contract: off-mesh workers synchronize through the
+        sync server's clocks, not this barrier.)"""
         if self._barrier is not None and self._local_workers > 1:
             self._barrier.wait()
-        if self._process_count > 1:
-            local = getattr(_thread_local, "worker_slot", 0)
-            if local == 0:
-                self.process_barrier()
-            if self._barrier is not None and self._local_workers > 1:
-                self._barrier.wait()
 
     def process_barrier(self) -> None:
-        """Cross-process sync only (one caller per process) — used by
-        lifecycle code paths that run once per process, not per worker."""
-        if self._process_count > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("mv_barrier")
+        """Lifecycle hook; a no-op under the single-mesh-process contract
+        (kept so lifecycle code reads the same as the reference's
+        barrier-after-create shape)."""
 
     # -- tables ------------------------------------------------------------
     def register_table(self, worker_table: Any, server_table: Any) -> int:
@@ -189,7 +219,9 @@ class Zoo:
     # -- aggregate (model averaging) ----------------------------------------
     def aggregate(self, data: np.ndarray) -> np.ndarray:
         """In-place-sum semantics of ``MV_Aggregate``: returns the elementwise
-        sum of `data` across every worker (all processes × local workers)."""
+        sum of `data` across every local worker context. Off-mesh processes
+        aggregate via the raw-net ring allreduce
+        (:class:`multiverso_tpu.runtime.net.AllreduceEngine`)."""
         data = np.asarray(data)
         slot = self.current_worker_id()
         with self._agg_lock:
@@ -201,10 +233,6 @@ class Zoo:
             with self._agg_lock:
                 total = np.sum(list(self._agg_slots.values()), axis=0)
                 self._agg_slots.clear()
-            if self._process_count > 1:
-                from jax.experimental import multihost_utils
-                gathered = multihost_utils.process_allgather(total)
-                total = np.sum(gathered, axis=0)
             self._agg_result = total
         if self._barrier is not None and self._local_workers > 1:
             self._barrier.wait()

@@ -90,13 +90,17 @@ class ArrayServer(ServerTable):
         if self.padded != self.size:
             delta = np.pad(delta, (0, self.padded - self.size))
         scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
-        worker = jnp.int32(option.worker_id % max(1, self.num_workers))
+        # administrative access (worker id -1) charges slot 0, not slot n-1
+        worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
         self.data, self.states = self._update(self.data, self.states,
                                               jnp.asarray(delta), worker, scalars)
 
     def process_get(self, request: Optional[GetOption]) -> np.ndarray:
         out = self.updater.access(self.data)
         return np.asarray(jax.device_get(out))[: self.size]
+
+    def remote_spec(self):
+        return {"kind": "array", "size": self.size, "dtype": self.dtype.str}
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
@@ -145,7 +149,7 @@ class ArrayWorker(WorkerTable):
     def _default_option(self, option: Optional[AddOption]) -> AddOption:
         if option is None:
             option = AddOption()
-            option.worker_id = self._zoo.current_worker_id()
+            option.worker_id = self._channel.worker_id()
         return option
 
     # -- TPU-era fast path -------------------------------------------------

@@ -52,12 +52,38 @@ class Completion:
         return self.result
 
 
+class LocalChannel:
+    """Default request channel: the in-process dispatcher queue (workers and
+    server shards share the mesh — no wire). The remote equivalent lives in
+    :mod:`multiverso_tpu.runtime.remote`."""
+
+    def __init__(self) -> None:
+        self._zoo = Zoo.instance()
+
+    def worker_id(self) -> int:
+        return self._zoo.current_worker_id()
+
+    def submit(self, table_id: int, msg_type: MsgType, request: Any,
+               msg_id: int, completion: "Completion") -> None:
+        msg = Message(src=self.worker_id(), dst=-1, type=msg_type,
+                      table_id=table_id, msg_id=msg_id,
+                      data=[request, completion])
+        self._zoo.server.send(msg)
+
+    def post(self, table_id: int, msg_type: MsgType) -> None:
+        """Fire-and-forget control message (Server_Finish_Train)."""
+        msg = Message(src=self.worker_id(), dst=-1, type=msg_type,
+                      table_id=table_id, msg_id=next_msg_id())
+        self._zoo.server.send(msg)
+
+
 class WorkerTable:
     """Client proxy: issues Get/Add messages, tracks outstanding replies."""
 
-    def __init__(self) -> None:
+    def __init__(self, channel: Optional[Any] = None) -> None:
         self.table_id: int = -1
-        self._zoo = Zoo.instance()
+        self._channel = channel if channel is not None else LocalChannel()
+        self._zoo = Zoo.instance() if channel is None else None
         self._pending: Dict[int, Completion] = {}
         self._pending_request: Dict[int, Any] = {}
         self._lock = threading.Lock()
@@ -74,10 +100,8 @@ class WorkerTable:
         with self._lock:
             self._pending[msg_id] = completion
             self._pending_request[msg_id] = request
-        msg = Message(src=self._zoo.current_worker_id(), dst=-1, type=msg_type,
-                      table_id=self.table_id, msg_id=msg_id,
-                      data=[request, completion])
-        self._zoo.server.send(msg)
+        self._channel.submit(self.table_id, msg_type, request, msg_id,
+                             completion)
         return msg_id
 
     def get_async(self, request: Any) -> int:
@@ -116,10 +140,7 @@ class WorkerTable:
     def finish_train(self) -> None:
         """Signal end-of-training so BSP clocks release peers
         (reference: ``Server_Finish_Train``)."""
-        msg = Message(src=self._zoo.current_worker_id(), dst=-1,
-                      type=MsgType.Server_Finish_Train,
-                      table_id=self.table_id, msg_id=next_msg_id())
-        self._zoo.server.send(msg)
+        self._channel.post(self.table_id, MsgType.Server_Finish_Train)
 
 
 class ServerTable:
@@ -127,6 +148,11 @@ class ServerTable:
 
     def __init__(self) -> None:
         self.table_id: int = -1
+
+    def remote_spec(self) -> Optional[Dict[str, Any]]:
+        """Metadata a remote client needs to build a matching worker proxy
+        (kind + shape + dtype); None = not servable over the wire."""
+        return None
 
     def process_add(self, request: Any) -> None:
         raise NotImplementedError

@@ -45,6 +45,9 @@ class KVServer(ServerTable):
         zero = self.value_dtype.type(0)
         return [self._store.get(k, zero) for k in keys]
 
+    def remote_spec(self):
+        return {"kind": "kv", "dtype": self.value_dtype.str}
+
     def store(self, stream) -> None:
         items = sorted(self._store.items())
         stream.write(struct.pack("<q", len(items)))

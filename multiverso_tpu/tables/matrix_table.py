@@ -44,6 +44,13 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def _use_pallas_scatter(backend: str, num_shards: int) -> bool:
+    """Pallas row-DMA scatter serves single-shard TPU tables only:
+    pallas_call has no SPMD partitioning rule, so multi-device tables take
+    XLA's scatter (which partitions fine)."""
+    return backend == "tpu" and num_shards == 1
+
+
 class MatrixServer(ServerTable):
     def __init__(self, num_row: int, num_col: int, dtype: Any = np.float32,
                  updater_type: str = "", num_workers: Optional[int] = None,
@@ -101,7 +108,8 @@ class MatrixServer(ServerTable):
         self._linear = type(self.updater) in (Updater, SGDUpdater)
         self._sign = -1.0 if isinstance(self.updater, SGDUpdater) else 1.0
         self._gather = jax.jit(lambda data, ids: data[ids])
-        self._pallas_scatter = jax.default_backend() == "tpu"
+        self._pallas_scatter = _use_pallas_scatter(
+            jax.default_backend(), num_shards)
         if self._pallas_scatter:
             from multiverso_tpu.ops.pallas_rows import scatter_add_rows
             self._scatter_add = scatter_add_rows  # unique-id contract: see process_add
@@ -151,7 +159,8 @@ class MatrixServer(ServerTable):
         row_ids, values, option = request
         option = option or AddOption()
         scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
-        worker = jnp.int32(option.worker_id % max(1, self.num_workers))
+        # administrative access (worker id -1) charges slot 0, not slot n-1
+        worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
         if row_ids is None:
             delta = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
             delta[: self.num_row, : self.num_col] = np.asarray(
@@ -200,12 +209,13 @@ class MatrixServer(ServerTable):
             self._gather(self.data, ids_p)))[:n, : self.num_col]
         if self.is_sparse and option is not None:
             with self._std_lock:
-                self._up_to_date[option.worker_id % self.num_workers, row_ids] = True
+                self._up_to_date[max(option.worker_id, 0) % self.num_workers,
+                                 row_ids] = True
         return rows
 
     def _sparse_get(self, option: GetOption):
         """Return only the rows stale for this worker: (ids, rows)."""
-        w = option.worker_id % self.num_workers
+        w = max(option.worker_id, 0) % self.num_workers
         with self._std_lock:
             stale = np.where(~self._up_to_date[w])[0].astype(np.int32)
             self._up_to_date[w, stale] = True
@@ -218,6 +228,11 @@ class MatrixServer(ServerTable):
         rows = np.asarray(jax.device_get(
             self._gather(self.data, ids_p)))[:n, : self.num_col]
         return stale, rows
+
+    def remote_spec(self):
+        return {"kind": "matrix", "num_row": self.num_row,
+                "num_col": self.num_col, "dtype": self.dtype.str,
+                "is_sparse": self.is_sparse}
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
@@ -305,12 +320,12 @@ class MatrixWorker(WorkerTable):
     def _default_add_option(self, option: Optional[AddOption]) -> AddOption:
         if option is None:
             option = AddOption()
-            option.worker_id = self._zoo.current_worker_id()
+            option.worker_id = self._channel.worker_id()
         return option
 
     def _default_get_option(self, option: Optional[GetOption]) -> GetOption:
         if option is None:
-            option = GetOption(worker_id=self._zoo.current_worker_id())
+            option = GetOption(worker_id=self._channel.worker_id())
         return option
 
     # -- TPU-era fast path -------------------------------------------------

@@ -78,6 +78,31 @@ def test_multiple_groups(rng):
     np.testing.assert_allclose(np.asarray(got), expect[ids], rtol=1e-6)
 
 
+def test_pallas_scatter_gate_predicate():
+    """pallas_call has no SPMD partitioning rule: the gate must refuse
+    multi-shard tables even on TPU (tested directly — on the CPU mesh the
+    backend clause alone would mask a regression of the shard clause)."""
+    from multiverso_tpu.tables.matrix_table import _use_pallas_scatter
+
+    assert _use_pallas_scatter("tpu", 1)
+    assert not _use_pallas_scatter("tpu", 8)
+    assert not _use_pallas_scatter("cpu", 1)
+
+
+def test_matrix_server_multi_shard_add_correct(mv_env):
+    """A table sharded over the 8-device mesh takes the XLA scatter branch
+    and row adds land correctly."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    assert Zoo.instance().num_servers > 1  # the 8-device virtual mesh
+    table = mv.create_table("matrix", 64, 16, np.float32)
+    assert not table._server_table._pallas_scatter
+    ids = np.array([1, 9, 42], np.int32)
+    table.add(np.full((3, 16), 2.0, np.float32), row_ids=ids)
+    np.testing.assert_allclose(table.get(ids), np.full((3, 16), 2.0))
+
+
 def test_scatter_mean_step_dedup(rng):
     from multiverso_tpu.ops.scatter import scatter_mean_step
 
