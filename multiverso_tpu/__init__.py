@@ -191,7 +191,7 @@ def net() :
 # -- tables -----------------------------------------------------------------
 
 from multiverso_tpu.tables.array_table import ArrayServer, ArrayWorker  # noqa: E402
-from multiverso_tpu.tables.kv_table import KVServer, KVWorker  # noqa: E402
+from multiverso_tpu.tables.kv_table import DeviceKVServer, KVServer, KVWorker  # noqa: E402
 from multiverso_tpu.tables.matrix_table import MatrixServer, MatrixWorker  # noqa: E402
 from multiverso_tpu.updaters import AddOption, GetOption  # noqa: E402,F401
 

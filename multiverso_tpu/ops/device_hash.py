@@ -1,0 +1,103 @@
+"""Device-resident static-capacity hash table (open addressing, int32 keys).
+
+Reference capability (not copied): ``KVTable`` — a distributed
+``unordered_map<Key,Val>`` hash-sharded ``key % num_servers`` across server
+ranks (``include/multiverso/table/kv_table.h:19-118``). Its storage was host
+RAM behind each server actor.
+
+TPU-native re-design (SURVEY §7 hard part (e): "arbitrary keys →
+static-shape-friendly hashing"): the table is a pair of fixed-capacity
+device arrays (keys int32 / values) probed by double hashing — every op is
+a statically-shaped jitted program:
+
+* ``add``: K claim rounds. Each round scatters unresolved keys at their
+  probe slot (only onto EMPTY slots; losers of a duplicate-index scatter
+  are detected by a confirming gather and retry at the next probe), then
+  scatter-adds the winners' values. Batch keys must be unique (the caller
+  pre-combines duplicates) — the claim protocol relies on it.
+* ``get``: K probe rounds of gather + compare; missing keys read 0.
+* Slot ``capacity`` is a scratch: masked-out lanes scatter there, so no
+  branches and no dynamic shapes anywhere.
+
+Unresolved keys after K rounds are counted in an overflow counter — the
+caller sizes capacity ≥ 2× expected keys (load factor ≤ 0.5, where K=16
+double-hash probes practically never exhaust) and treats overflow > 0 as a
+capacity error. Keys are int32 ≥ 0 (-1 is EMPTY / batch padding); JAX's
+x64-off default makes int64 keys impractical on-device — the host-dict
+KVServer remains for arbitrary-width control-plane keys.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+EMPTY = -1
+MAX_PROBE = 16
+
+
+def _probe_slot(key: jax.Array, probe, capacity: int) -> jax.Array:
+    """Double hashing over a power-of-two capacity: h1 + p*h2 with h2 odd
+    (odd step sizes are coprime to 2^n, so the sequence covers all slots)."""
+    k = key.astype(jnp.uint32)
+    h1 = k * jnp.uint32(2654435761)
+    h1 = h1 ^ (h1 >> 15)
+    h2 = (k * jnp.uint32(40503)) | jnp.uint32(1)
+    p = jnp.uint32(probe) if not isinstance(probe, jax.Array) else probe.astype(jnp.uint32)
+    return ((h1 + p * h2) & jnp.uint32(capacity - 1)).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("capacity",), donate_argnums=(0, 1))
+def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
+             batch_values: jax.Array, capacity: int
+             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Insert-or-accumulate a batch of UNIQUE keys (pad with -1).
+
+    keys/values have length capacity+1 (last slot is scratch). Returns
+    (keys, values, overflow_count)."""
+    live = batch_keys >= 0
+    resolved = ~live
+    slot_found = jnp.zeros_like(batch_keys)
+
+    # static unroll: under shard_map a fori_loop carry would mix varying
+    # (sharded keys) and unvarying (batch) types, which scan rejects
+    for p in range(MAX_PROBE):
+        cand = _probe_slot(batch_keys, p, capacity)
+        cur = keys[cand]
+        match = (cur == batch_keys) & ~resolved
+        claimable = (cur == EMPTY) & ~resolved
+        # claim empties; duplicate-index scatters let exactly one lane land,
+        # the confirming gather below tells the winner from the losers
+        scatter_idx = jnp.where(claimable, cand, capacity)
+        keys = keys.at[scatter_idx].set(
+            jnp.where(claimable, batch_keys, EMPTY))
+        confirmed = keys[cand] == batch_keys
+        won = (match | claimable) & confirmed & ~resolved
+        slot_found = jnp.where(won, cand, slot_found)
+        resolved = resolved | won
+    vidx = jnp.where(resolved & live, slot_found, capacity)
+    values = values.at[vidx].add(batch_values)
+    # scratch slot accumulates masked lanes' garbage; reset it
+    keys = keys.at[capacity].set(EMPTY)
+    values = values.at[capacity].set(0)
+    overflow = jnp.sum(live & ~resolved)
+    return keys, values, overflow
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def hash_get(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
+             capacity: int) -> jax.Array:
+    """Lookup a batch of keys (pad with -1); missing/padded keys read 0."""
+    live = batch_keys >= 0
+    out = jnp.zeros(batch_keys.shape, values.dtype)
+    found = ~live
+    for p in range(MAX_PROBE):  # static unroll (see hash_add)
+        cand = _probe_slot(batch_keys, p, capacity)
+        cur = keys[cand]
+        hit = (cur == batch_keys) & ~found
+        out = jnp.where(hit, values[cand], out)
+        found = found | hit
+    return out

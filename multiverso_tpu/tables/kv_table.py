@@ -1,17 +1,21 @@
-"""KVTable — distributed key-value table with arbitrary integer keys.
+"""KVTable — distributed key-value table with integer keys.
 
 Reference capability (not copied): header-only distributed
 ``unordered_map<Key,Val>`` hash-sharded ``key % num_servers``, with a
 worker-side local cache ``raw()`` (``include/multiverso/table/kv_table.h``);
 its ``Store/Load`` were Fatal stubs — implemented for real here.
 
-TPU-native design note: in the reference this table holds *control-plane*
-state (e.g. word counts) on host RAM. The rebuild keeps that contract —
-host-side store behind the dispatcher thread (so the consistency modes apply
-uniformly) — while the *data-plane* sparse use case (huge embedding /
-topic-count matrices keyed by token id) belongs to the row-sharded
-MatrixTable / embedding ops, which keep values in HBM. A device-resident
-static-capacity hash table is tracked as a follow-up in ops/.
+Two server backends behind one worker API:
+
+* :class:`KVServer` — host dict behind the dispatcher. Control-plane use
+  (word counts, arbitrary-width python ints).
+* :class:`DeviceKVServer` (``capacity=N``) — the data-plane design: keys
+  are placed on a server shard by ``key % num_servers`` (the reference's
+  placement contract, observable in the per-shard key arrays) and each
+  shard holds a static-capacity open-addressing hash in HBM
+  (:mod:`multiverso_tpu.ops.device_hash`), with Get/Add as one jitted
+  ``shard_map`` program over the table mesh — the lightLDA-shaped
+  sparse-KV store (SURVEY §7 hard part (e)).
 """
 
 from __future__ import annotations
@@ -21,6 +25,7 @@ from typing import Any, Dict, Iterable, Optional, Tuple, Union
 
 import numpy as np
 
+from multiverso_tpu import log
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
 
 
@@ -66,14 +71,160 @@ class KVServer(ServerTable):
             self._store[k] = v
 
 
-class KVWorker(WorkerTable):
-    """Client proxy with a local cache (reference: ``raw()``)."""
+class DeviceKVServer(ServerTable):
+    """Hash-sharded device-resident KV store (see module docstring)."""
 
     def __init__(self, value_dtype: Any = np.float32,
-                 server: Optional[KVServer] = None) -> None:
+                 capacity: int = 1 << 20) -> None:
+        super().__init__()
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from multiverso_tpu.ops import device_hash
+        from multiverso_tpu.parallel import mesh as mesh_lib
+        from multiverso_tpu.runtime.zoo import Zoo
+
+        zoo = Zoo.instance()
+        self.value_dtype = np.dtype(value_dtype)
+        if self.value_dtype.str not in ("<f4", "<i4"):
+            log.fatal("DeviceKVServer values must be float32/int32 (JAX "
+                      "x64-off); got %s — use the host KV table for wider "
+                      "types", self.value_dtype)
+        self.mesh = zoo.mesh
+        axis = self.mesh.axis_names[0]
+        self.num_shards = zoo.num_servers
+        per = max(64, -(-int(capacity) // self.num_shards))
+        per = 1 << (per - 1).bit_length()  # pow2 per-shard capacity
+        self.shard_capacity = per
+        self.capacity = per * self.num_shards
+
+        sharding = mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0,
+                                           axis=axis)
+        self.keys = jax.device_put(
+            np.full((self.num_shards, per + 1), device_hash.EMPTY, np.int32),
+            sharding)
+        self.values = jax.device_put(
+            np.zeros((self.num_shards, per + 1), self.value_dtype), sharding)
+
+        num_shards = self.num_shards
+
+        def add_body(keys_l, vals_l, bk, bv):
+            idx = jax.lax.axis_index(axis)
+            mine = (bk >= 0) & (bk % num_shards == idx)
+            k2, v2, ovf = device_hash.hash_add(
+                keys_l[0], vals_l[0], jnp.where(mine, bk, -1),
+                jnp.where(mine, bv, 0), per)
+            return k2[None], v2[None], ovf[None]
+
+        def get_body(keys_l, vals_l, bk):
+            idx = jax.lax.axis_index(axis)
+            mine = (bk >= 0) & (bk % num_shards == idx)
+            out = device_hash.hash_get(
+                keys_l[0], vals_l[0], jnp.where(mine, bk, -1), per)
+            return jax.lax.psum(out, axis)
+
+        self._add = jax.jit(jax.shard_map(
+            add_body, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=(P(axis), P(axis), P(axis))), donate_argnums=(0, 1))
+        self._get = jax.jit(jax.shard_map(
+            get_body, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P()), out_specs=P()))
+
+    @staticmethod
+    def _bucket(arr: np.ndarray, fill, dtype) -> np.ndarray:
+        n = max(64, 1 << (max(len(arr), 1) - 1).bit_length())
+        out = np.full(n, fill, dtype)
+        out[: len(arr)] = arr
+        return out
+
+    def process_add(self, request) -> None:
+        import jax.numpy as jnp
+        keys, values, _option = request
+        keys = np.asarray(keys, dtype=np.int64).reshape(-1)
+        if keys.size and keys.min() < 0:
+            log.fatal("DeviceKV keys must be >= 0")
+        if keys.size and keys.max() >= (1 << 31):
+            log.fatal("DeviceKV keys must fit int32")
+        vals = np.asarray(values, dtype=self.value_dtype).reshape(-1)
+        ukeys, inv = np.unique(keys.astype(np.int32), return_inverse=True)
+        uvals = np.zeros(len(ukeys), self.value_dtype)
+        np.add.at(uvals, inv, vals)
+        bk = jnp.asarray(self._bucket(ukeys, -1, np.int32))
+        bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
+        self.keys, self.values, ovf = self._add(self.keys, self.values, bk, bv)
+        if int(np.asarray(ovf).sum()):
+            log.fatal("DeviceKV capacity exhausted (%d keys overflowed; "
+                      "capacity=%d)", int(np.asarray(ovf).sum()), self.capacity)
+
+    def process_get(self, request):
+        import jax
+        import jax.numpy as jnp
+        keys, _option = request
+        if keys is None:
+            k = np.asarray(jax.device_get(self.keys))[:, :-1].reshape(-1)
+            v = np.asarray(jax.device_get(self.values))[:, :-1].reshape(-1)
+            live = k >= 0
+            return {int(kk): self.value_dtype.type(vv)
+                    for kk, vv in zip(k[live], v[live])}
+        keys = np.asarray(keys, dtype=np.int32).reshape(-1)
+        bk = jnp.asarray(self._bucket(keys, -1, np.int32))
+        out = np.asarray(jax.device_get(self._get(self.keys, self.values, bk)))
+        return list(out[: len(keys)])
+
+    def remote_spec(self):
+        return {"kind": "kv", "dtype": self.value_dtype.str}
+
+    # -- checkpoint (live pairs only) ---------------------------------------
+    def store(self, stream) -> None:
+        pairs = self.process_get((None, None))
+        items = sorted(pairs.items())
+        stream.write(struct.pack("<q", len(items)))
+        for k, v in items:
+            stream.write(struct.pack("<q", int(k)))
+            stream.write(np.asarray(v, dtype=self.value_dtype).tobytes())
+
+    def load(self, stream) -> None:
+        (count,) = struct.unpack("<q", stream.read(8))
+        item = self.value_dtype.itemsize
+        keys = np.empty(count, np.int64)
+        vals = np.empty(count, self.value_dtype)
+        for i in range(count):
+            (keys[i],) = struct.unpack("<q", stream.read(8))
+            vals[i] = np.frombuffer(stream.read(item),
+                                    dtype=self.value_dtype)[0]
+        # reset and replay
+        import jax
+        from multiverso_tpu.ops import device_hash
+        from multiverso_tpu.parallel import mesh as mesh_lib
+        sharding = mesh_lib.table_sharding(
+            self.mesh, ndim=2, shard_dim=0, axis=self.mesh.axis_names[0])
+        self.keys = jax.device_put(
+            np.full((self.num_shards, self.shard_capacity + 1),
+                    device_hash.EMPTY, np.int32), sharding)
+        self.values = jax.device_put(
+            np.zeros((self.num_shards, self.shard_capacity + 1),
+                     self.value_dtype), sharding)
+        if count:
+            self.process_add((keys, vals, None))
+
+
+class KVWorker(WorkerTable):
+    """Client proxy with a local cache (reference: ``raw()``). Pass
+    ``capacity=N`` for the device-resident hash-sharded backend."""
+
+    def __init__(self, value_dtype: Any = np.float32,
+                 capacity: Optional[int] = None,
+                 server: Optional[ServerTable] = None) -> None:
         super().__init__()
         self.value_dtype = np.dtype(value_dtype)
-        self._server_table = server or KVServer(value_dtype)
+        if server is not None:
+            self._server_table = server
+        elif capacity is not None:
+            self._server_table = DeviceKVServer(value_dtype, capacity)
+        else:
+            self._server_table = KVServer(value_dtype)
         self._register(self._server_table)
         self._raw: Dict[int, Any] = {}
 

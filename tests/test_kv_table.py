@@ -46,3 +46,69 @@ def test_kv_store_load(mv_env):
     stream.seek(0)
     table2._server_table.load(stream)
     assert table2.get([5, 9]) == [1.0, 4.0]
+
+
+# -- device-resident hash-sharded backend ------------------------------------
+
+def test_device_kv_add_get(mv_env):
+    table = mv.create_table("kv", np.float32, capacity=4096)
+    table.add([0, 17, 123456], [1.0, 2.0, 3.0])
+    assert [float(v) for v in table.get([0, 17, 123456])] == [1.0, 2.0, 3.0]
+    table.add([17], [10.0])
+    assert float(table.get(17)) == 12.0
+    assert float(table.get(424242)) == 0.0  # missing key -> zero
+
+
+def test_device_kv_placement_is_key_mod_num_servers(mv_env):
+    """The reference placement contract, observable in the per-shard key
+    arrays: shard s holds exactly the keys with key % num_servers == s."""
+    import jax
+
+    table = mv.create_table("kv", np.int32, capacity=1024)
+    server = table._server_table
+    keys = np.arange(0, 999, 7)
+    table.add(keys, np.ones(len(keys), np.int32))
+    stored = np.asarray(jax.device_get(server.keys))[:, :-1]
+    for s in range(server.num_shards):
+        live = stored[s][stored[s] >= 0]
+        assert len(live) > 0
+        assert np.all(live % server.num_shards == s), (s, live)
+    total = sum((stored[s] >= 0).sum() for s in range(server.num_shards))
+    assert total == len(keys)
+
+
+def test_device_kv_duplicate_keys_in_one_add(mv_env):
+    table = mv.create_table("kv", np.float32, capacity=512)
+    table.add([9, 9, 9, 4], [1.0, 2.0, 3.0, 0.5])
+    assert float(table.get(9)) == 6.0
+    assert float(table.get(4)) == 0.5
+
+
+def test_device_kv_whole_get_and_store_load(mv_env):
+    table = mv.create_table("kv", np.float32, capacity=512)
+    table.add([5, 900, 31], [1.0, 4.0, 2.0])
+    assert table.get() == {5: 1.0, 900: 4.0, 31: 2.0}
+    stream = MemoryStream()
+    table._server_table.store(stream)
+    table2 = mv.create_table("kv", np.float32, capacity=512)
+    stream.seek(0)
+    table2._server_table.load(stream)
+    assert [float(v) for v in table2.get([5, 900, 31])] == [1.0, 4.0, 2.0]
+
+
+def test_device_kv_lightlda_stress(mv_env):
+    """lightLDA-shaped stress: a large skewed (zipf) key space with repeated
+    batched adds; exact counts must survive hashing, sharding, and claims."""
+    rng = np.random.default_rng(0)
+    n_keys = 200_000
+    table = mv.create_table("kv", np.float32, capacity=2 * n_keys)
+    expected = np.zeros(n_keys, np.float64)
+    for _ in range(5):
+        # zipf-skewed batch: hot keys repeat heavily within a batch
+        batch = (rng.zipf(1.3, size=50_000) % n_keys).astype(np.int64)
+        table.add(batch, np.ones(len(batch), np.float32))
+        np.add.at(expected, batch, 1.0)
+    check = np.concatenate([np.arange(2000),
+                            rng.choice(n_keys, 2000, replace=False)])
+    got = np.asarray(table.get(list(check)), np.float64)
+    np.testing.assert_allclose(got, expected[check])
