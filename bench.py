@@ -96,6 +96,64 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
     return words / per_pass, loss
 
 
+def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
+    """End-to-end parameter-server words/sec: the full product path —
+    candidate-row pulls through the dispatcher, compact-space scan training,
+    delta pushes through the updater (the reference's only benchmarked
+    configuration: WordEmbedding skip-gram on PS tables).
+
+    Timing is wall-clock over train_block calls, which is honest here by
+    construction: every block ends in host-side numpy deltas computed from
+    fetched rows — a dependent fetch per block — so async dispatch cannot
+    underreport. Slope over block counts removes compile time.
+    """
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.vocab import Dictionary
+    from multiverso_tpu.models.word2vec import PSTrainer, Word2VecConfig
+
+    counts = np.maximum((1e7 / np.arange(1, vocab + 1)).astype(np.int64), 5)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {}
+    d.counts = counts
+    config = Word2VecConfig(vocab_size=vocab, dim=dim, window=5, negatives=5,
+                            batch_pairs=8192, sample=0.0)
+
+    p = counts.astype(np.float64) / counts.sum()
+    cdf = np.cumsum(p)
+    rng = np.random.default_rng(0)
+    blocks = [np.searchsorted(cdf, rng.random(block_tokens)).astype(np.int32)
+              for _ in range(n_blocks)]
+
+    mv.init([])
+    try:
+        trainer = PSTrainer(config, d)
+        for b in blocks[:2]:  # compile + warm the pow2 trace buckets
+            trainer.train_block(b)
+
+        def run(k):
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for i in range(k):
+                    trainer.train_block(blocks[i % n_blocks])
+                best = min(best, time.perf_counter() - t0)
+            return best
+        k1, k2 = 2, 6
+        t1 = run(k1)
+        t2 = run(k2)
+        per_block = (t2 - t1) / (k2 - k1)
+        if per_block <= 0:
+            per_block = t2 / k2
+        stats = trainer.last_block_stats
+        return {
+            "ps_words_per_sec": round(block_tokens / per_block, 1),
+            "ps_rows_pulled_per_block": stats["in_rows"] + stats["out_rows"],
+        }
+    finally:
+        mv.shutdown()
+
+
 def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
     """Device-path row Add/Get on the reference perf-harness table
     (1M×50 fp32, physically 128-lane padded like ``MatrixServer``).
@@ -214,20 +272,25 @@ def bench_wire_compression(rows=1024, cols=128, nonzero_rows=0.1):
 
 def main():
     words_per_sec, final_loss = bench_word2vec()
+    ps = bench_ps_word2vec()
     matrix = bench_matrix_table()
     wire_ratio = bench_wire_compression()
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
         "unit": "words/s",
-        # the only quantified target in BASELINE.json: matrix row-Add
-        # p50 < 50us; the reference published no words/sec figure
-        "vs_baseline": round(50.0 / matrix["matrix_add_p50_us"], 2),
-        "vs_baseline_note": ("ratio = BASELINE.json matrix-add p50 target "
-                             "(50us) / measured p50; no published words/sec "
-                             "baseline exists"),
+        # no published words/sec baseline exists (BASELINE.md: the reference
+        # only ever logged a live "Words/thread/second" line), so no ratio is
+        # reported for the headline metric; the one quantified BASELINE.json
+        # target (matrix row-Add p50 < 50us) gets its own field below
+        "vs_baseline": None,
+        "vs_baseline_note": ("no published words/sec baseline; see "
+                             "matrix_add_p50_vs_target for the quantified "
+                             "BASELINE.json latency target (>1 = beating it)"),
+        "matrix_add_p50_vs_target": round(50.0 / matrix["matrix_add_p50_us"], 2),
         "final_loss": round(final_loss, 4),
         "wire_sparse_compression_x": wire_ratio,
+        **ps,
         **matrix,
     }
     print(json.dumps(result))

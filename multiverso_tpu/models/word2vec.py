@@ -37,6 +37,7 @@ from multiverso_tpu import log
 from multiverso_tpu.models.vocab import Dictionary, HuffmanEncoder
 from multiverso_tpu.ops.sampling import unigram_negative_sampler
 from multiverso_tpu.parallel import mesh as mesh_lib
+from multiverso_tpu.utils import next_pow2 as _next_pow2
 
 
 @dataclass(frozen=True)
@@ -424,6 +425,23 @@ def generate_cbow_batches(block: np.ndarray, window: int,
 
 # -- trainers ---------------------------------------------------------------
 
+def _train_loop(trainer, blocks: Iterable[np.ndarray], epochs: int,
+                log_every_s: float, label: str) -> None:
+    """Shared epoch loop with throttled words/sec logging (the reference's
+    ``Trainer::TrainIteration`` log shape) — used by both trainers."""
+    t0 = time.time()
+    last = t0
+    blocks = list(blocks)
+    for _ in range(epochs):
+        for block in blocks:
+            trainer.train_block(block)
+            now = time.time()
+            if now - last > log_every_s:
+                rate = trainer.words_trained / (now - t0)
+                log.info("%sWords/sec: %.0fk  (trained %d)",
+                         label, rate / 1e3, trainer.words_trained)
+                last = now
+
 class DeviceTrainer:
     """HBM-resident training: embeddings live sharded on the mesh; the hot
     loop is host pair-gen → device step. Logs words/sec like the reference's
@@ -484,86 +502,225 @@ class DeviceTrainer:
 
     def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
               log_every_s: float = 10.0) -> None:
-        t0 = time.time()
-        last = t0
-        blocks = list(blocks)
-        for _ in range(epochs):
-            for block in blocks:
-                self.train_block(block)
-                now = time.time()
-                if now - last > log_every_s:
-                    rate = self.words_trained / (now - t0)
-                    log.info("Words/sec: %.0fk  (trained %d)",
-                             rate / 1e3, self.words_trained)
-                    last = now
+        _train_loop(self, blocks, epochs, log_every_s, "")
         jax.block_until_ready(self.params["w_in"])
 
     def embeddings(self) -> np.ndarray:
         return np.asarray(self.params["w_in"])[: self.config.vocab_size]
 
 
-class PSTrainer:
-    """Parameter-server client path: embeddings live in MatrixTables; each
-    block pulls the rows it touches, trains locally, pushes delta = trained −
-    cached (the reference client contract: ``communicator.cpp:17-32``,
-    ``RequestParameter``/``AddDeltaParameter``)."""
+def host_negative_sampler(counts: np.ndarray, power: float = 0.75):
+    """Host-side alias sampler over counts^0.75 — the PS client pre-draws its
+    negatives so the candidate row set is known BEFORE the pull (the
+    reference's client likewise knew its negative rows host-side via the
+    unigram table; ``Applications/WordEmbedding/src/trainer.cpp``)."""
+    from multiverso_tpu.ops.sampling import build_alias_table
+    p = np.asarray(counts, dtype=np.float64) ** power
+    thr, ali = build_alias_table(p)
+    v = len(thr)
 
-    def __init__(self, config: Word2VecConfig, dictionary: Dictionary) -> None:
+    def draw(rng: np.random.Generator, shape) -> np.ndarray:
+        idx = rng.integers(0, v, size=shape)
+        u = rng.random(shape)
+        return np.where(u < thr[idx], idx, ali[idx]).astype(np.int32)
+
+    return draw
+
+
+def make_candidate_train_step(config: Word2VecConfig):
+    """Compact-space block step for the PS client: ONE device dispatch trains
+    a whole stack of minibatches whose ids are already remapped into the
+    pulled candidate-row space.
+
+    step(w_in_c, w_out_c, batches, lr) -> (w_in_c, w_out_c, loss_sum, mask_sum)
+    where batches stacks N minibatches: in_ids/in_weights (N,B,C) and
+    out_ids/labels/mask (N,B,T), ids compact (sentinel = last row). The scan
+    keeps per-occurrence SGD semantics sequential ACROSS minibatches (like
+    the reference's hot loop) while each minibatch is one MXU einsum set.
+    """
+    combine = config.grad_combine
+    cap = config.max_row_step
+
+    def step(w_in_c, w_out_c, batches, lr):
+        def body(carry, b):
+            w_in, w_out = carry
+            w_in, w_out, loss = _sgns_core(
+                w_in, w_out, b["in_ids"], b["in_weights"], b["out_ids"],
+                b["labels"], b["mask"], lr, combine, cap)
+            return (w_in, w_out), (loss * jnp.maximum(b["mask"].sum(), 0.0),
+                                   b["mask"].sum())
+        (w_in_c, w_out_c), (losses, weights) = jax.lax.scan(
+            body, (w_in_c, w_out_c), batches)
+        return w_in_c, w_out_c, losses.sum(), weights.sum()
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+class PSTrainer:
+    """Parameter-server client: embeddings live in MatrixTables; each block
+    pulls ONLY its candidate rows, trains a compact local model in one scan
+    dispatch, and pushes per-row deltas (or raw gradients when the server
+    owns the optimizer).
+
+    Reference capability (not copied): the 4-table AdaGrad recipe
+    (``Applications/WordEmbedding/src/communicator.cpp:17-32``, table ids in
+    ``constant.h:15-20``) with candidate-row ``RequestParameter`` pulls and
+    all four mode×objective combinations
+    (``distributed_wordembedding.cpp:147-252``).
+
+    TPU-era re-design: the reference kept AdaGrad sum-gradient matrices as
+    two EXTRA client-visible tables because its servers could only +=; here
+    the server applies the optimizer (``updater_type="adagrad"`` tables own
+    their accumulators in HBM), so the client pushes raw gradients and the
+    two sum-gradient tables collapse into server updater state. Negatives
+    (or Huffman path points) are pre-drawn host-side so the pull touches
+    exactly the rows the block will train — no O(V) host transfer anywhere.
+    """
+
+    def __init__(self, config: Word2VecConfig, dictionary: Dictionary,
+                 use_adagrad: bool = False) -> None:
         import multiverso_tpu as mv
-        if config.objective != "ns" or config.mode != "sg":
-            log.fatal("PSTrainer currently supports sg+ns (the benchmarked path)")
         self.config = config
         self.dictionary = dictionary
+        self.use_adagrad = bool(use_adagrad)
         v = config.vocab_size
+        out_rows = v if config.objective == "ns" else max(v - 1, 1)
+        updater = "adagrad" if self.use_adagrad else "default"
+        # reference table ids 0..4: input, output, (2 sum-gradient tables —
+        # subsumed by server updater state), wordcount
         self.input_table = mv.create_table(
-            "matrix", v, config.dim, np.float32,
+            "matrix", v, config.dim, np.float32, updater_type=updater,
             init_range=(-0.5 / config.dim, 0.5 / config.dim), seed=config.seed)
-        self.output_table = mv.create_table("matrix", v, config.dim, np.float32)
+        self.output_table = mv.create_table(
+            "matrix", out_rows, config.dim, np.float32, updater_type=updater)
         self.count_table = mv.create_table("kv", np.int64)
-        self.step_fn = make_train_step(config, dictionary)
-        self.key = jax.random.PRNGKey(config.seed)
+        self.out_rows = out_rows
+        if config.objective == "hs":
+            self.huffman = HuffmanEncoder(dictionary.counts,
+                                          config.max_code_length)
+            self._hs_mask = self.huffman.mask()
+        else:
+            self.huffman = None
+            self._neg_draw = host_negative_sampler(dictionary.counts)
+        self.step_fn = make_candidate_train_step(config)
         self.keep = dictionary.keep_probs(config.sample)
         self.rng = np.random.default_rng(config.seed)
         self.words_trained = 0
+        self.last_block_stats: Dict[str, int] = {}
 
-    def train_block(self, block: np.ndarray, lr: Optional[float] = None) -> None:
+    # -- host-side batch shaping ---------------------------------------------
+    def _block_pairs(self, block: np.ndarray):
+        """(in_tok (P,C), in_w (P,C), predict (P,)) for this block's mode.
+        in_tok may contain -1 (masked context slots)."""
+        if self.config.mode == "sg":
+            centers, contexts = generate_sg_pairs(
+                block, self.config.window, self.rng)
+            in_tok = centers[:, None]
+            in_w = np.ones_like(in_tok, dtype=np.float32)
+            return in_tok, in_w, contexts
+        centers, ctx = generate_cbow_batches(block, self.config.window, self.rng)
+        valid = (ctx >= 0).astype(np.float32)
+        in_w = valid / np.maximum(valid.sum(1, keepdims=True), 1.0)
+        return ctx, in_w, centers
+
+    def _block_outputs(self, predict: np.ndarray):
+        """(out_tok (P,T), labels (P,T), mask (P,T)); out_tok -1 where masked."""
+        if self.config.objective == "ns":
+            k = self.config.negatives
+            negs = self._neg_draw(self.rng, (len(predict), k))
+            out_tok = np.concatenate([predict[:, None], negs], axis=1)
+            labels = np.zeros_like(out_tok, np.float32)
+            labels[:, 0] = 1.0
+            mask = np.ones_like(labels)
+            return out_tok, labels, mask
+        pts = self.huffman.points[predict]                   # (P, L)
+        codes = self.huffman.codes[predict]
+        mask = self._hs_mask[predict]
+        out_tok = np.where(mask > 0, pts, -1).astype(np.int32)
+        labels = (1.0 - codes).astype(np.float32) * mask
+        return out_tok, labels, mask
+
+    def train_block(self, block: np.ndarray,
+                    lr: Optional[float] = None) -> float:
         block = subsample_block(block, self.keep, self.rng)
         if len(block) < 2:
-            return
+            return 0.0
         lr = self.config.lr if lr is None else lr
-        rows = np.unique(block)
-        # pull touched rows; output rows include negatives — pull everything
-        # touched plus sampled negs is unknowable ahead, so pull rows for the
-        # block and keep a dense local copy of w_out (reference pulls the
-        # negative table rows the same way via sampled candidate sets).
-        local_in_rows = self.input_table.get(rows)
-        local_out = self.output_table.get()
-        w_in = np.zeros((self.config.vocab_size, self.config.dim), np.float32)
-        w_in[rows] = local_in_rows
-        params = {"w_in": jnp.asarray(w_in), "w_out": jnp.asarray(local_out)}
-        cached_in, cached_out = w_in.copy(), local_out.copy()
+        in_tok, in_w, predict = self._block_pairs(block)
+        if len(predict) == 0:
+            return 0.0
+        out_tok, labels, mask = self._block_outputs(predict)
 
+        # candidate sets: exactly the rows this block trains
+        in_cand = np.unique(in_tok[in_tok >= 0]).astype(np.int32)
+        out_cand = np.unique(out_tok[out_tok >= 0]).astype(np.int32)
+        cached_in = self.input_table.get(in_cand)
+        cached_out = self.output_table.get(out_cand)
+
+        # compact matrices: pow2 row buckets with a sentinel scratch row so
+        # jit traces are reused across blocks of different candidate counts
+        dim = self.config.dim
+        n_in, n_out = len(in_cand), len(out_cand)
+        r_in = max(_next_pow2(n_in + 1), 8)
+        r_out = max(_next_pow2(n_out + 1), 8)
+        w_in_c = np.zeros((r_in, dim), np.float32)
+        w_in_c[:n_in] = cached_in
+        w_out_c = np.zeros((r_out, dim), np.float32)
+        w_out_c[:n_out] = cached_out
+
+        # remap token ids → compact slots (sentinel = last row)
+        in_ids = np.where(in_tok >= 0,
+                          np.searchsorted(in_cand, np.maximum(in_tok, 0)),
+                          r_in - 1).astype(np.int32)
+        out_ids = np.where(out_tok >= 0,
+                           np.searchsorted(out_cand, np.maximum(out_tok, 0)),
+                           r_out - 1).astype(np.int32)
+
+        # stack minibatches: pad pairs to a full (N, B, ...) block aimed at
+        # the sentinels, N bucketed to pow2 for trace reuse
         bp = self.config.batch_pairs
-        centers, contexts = generate_sg_pairs(block, self.config.window, self.rng)
-        for i in range(0, max(len(centers) - bp + 1, 1), bp):
-            sl = slice(i, i + bp)
-            if len(centers[sl]) == 0:
-                break
-            self.key, sub = jax.random.split(self.key)
-            batch = {"centers": jnp.asarray(centers[sl]),
-                     "contexts": jnp.asarray(contexts[sl])}
-            params, _ = self.step_fn(params, sub, batch, lr)
+        p = len(predict)
+        n = _next_pow2(-(-p // bp))
+        def pad(arr, fill):
+            flat = np.full((n * bp,) + arr.shape[1:], fill, arr.dtype)
+            flat[:p] = arr
+            return flat.reshape((n, bp) + arr.shape[1:])
+        batches = {
+            "in_ids": pad(in_ids, r_in - 1),
+            "in_weights": pad(in_w, 0.0),
+            "out_ids": pad(out_ids, r_out - 1),
+            "labels": pad(labels, 0.0),
+            "mask": pad(mask, 0.0),
+        }
+        new_in, new_out, loss_sum, w_sum = self.step_fn(
+            jnp.asarray(w_in_c), jnp.asarray(w_out_c),
+            {k: jnp.asarray(v) for k, v in batches.items()}, lr)
+        new_in = np.asarray(new_in[:n_in])
+        new_out = np.asarray(new_out[:n_out])
 
-        new_in = np.asarray(params["w_in"])
-        new_out = np.asarray(params["w_out"])
-        delta_in = new_in[rows] - cached_in[rows]
-        self.input_table.add(delta_in, row_ids=rows)
-        out_delta = new_out - cached_out
-        touched_out = np.unique(np.nonzero(out_delta.any(axis=1))[0])
-        if len(touched_out):
-            self.output_table.add(out_delta[touched_out], row_ids=touched_out)
+        delta_in = new_in - cached_in
+        delta_out = new_out - cached_out
+        if self.use_adagrad:
+            # server owns the optimizer: ship the block's summed raw gradient
+            # G ≈ -(delta)/lr; the adagrad updater applies
+            # data -= lr·G/sqrt(g_sqr+rho) with HBM-resident accumulators
+            from multiverso_tpu.updaters import AddOption
+            opt = AddOption(worker_id=self.input_table._channel.worker_id(),
+                            learning_rate=lr)
+            self.input_table.add(-delta_in / lr, row_ids=in_cand, option=opt)
+            self.output_table.add(-delta_out / lr, row_ids=out_cand, option=opt)
+        else:
+            self.input_table.add(delta_in, row_ids=in_cand)
+            self.output_table.add(delta_out, row_ids=out_cand)
         self.count_table.add([0], [int(len(block))])
         self.words_trained += len(block)
+        self.last_block_stats = {"in_rows": n_in, "out_rows": n_out,
+                                 "pairs": p}
+        return float(loss_sum) / max(float(w_sum), 1.0)
+
+    def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
+              log_every_s: float = 10.0) -> None:
+        _train_loop(self, blocks, epochs, log_every_s, "PS ")
 
     def embeddings(self) -> np.ndarray:
         return self.input_table.get()

@@ -89,6 +89,7 @@ class RemoteServer:
         self._wid_lock = threading.Lock()
         self._next_remote = 0
         self._free_slots: List[int] = []  # recycled by Control_Deregister
+        self._leased: set = set()         # slots currently held by a client
         self.endpoint: Optional[str] = None
 
     def serve(self, endpoint: str = "127.0.0.1:0") -> str:
@@ -131,10 +132,22 @@ class RemoteServer:
             # history a newcomer must not inherit, so BSP keeps the
             # reference's static-membership contract (a departed worker's
             # slot stays retired; crashed clients are never reclaimed).
+            # Only a currently-leased remote slot is accepted: a duplicate or
+            # bogus deregister (src=-1, a local id, a replay) must not let
+            # two later clients share one worker id. A recycled slot DOES
+            # inherit the departed client's per-worker updater state
+            # (momentum/adagrad accumulators) — deliberate: that state is
+            # the slot's optimization history, exactly what the reference's
+            # static membership kept positional.
             from multiverso_tpu.runtime.server import SyncServer
             if not isinstance(self._zoo.server, SyncServer):
                 with self._wid_lock:
-                    self._free_slots.append(int(msg.src))
+                    if int(msg.src) in self._leased:
+                        self._leased.discard(int(msg.src))
+                        self._free_slots.append(int(msg.src))
+                    else:
+                        log.error("remote: ignoring deregister for slot %d "
+                                  "(not currently leased)", int(msg.src))
             return
         if msg.type == MsgType.Server_Finish_Train:
             self._zoo.server.send(Message(
@@ -155,6 +168,7 @@ class RemoteServer:
         with self._wid_lock:
             if self._free_slots:
                 worker_id = self._free_slots.pop()
+                self._leased.add(worker_id)
             elif self._next_remote >= self._zoo.remote_workers:
                 # refuse: an out-of-range worker id would alias slot-0
                 # per-worker state and bypass the BSP clocks
@@ -170,6 +184,7 @@ class RemoteServer:
             else:
                 worker_id = base + self._next_remote
                 self._next_remote += 1
+                self._leased.add(worker_id)
         directory = []
         # snapshot: create_table on the main thread mutates the dict
         for table_id, table in list(self._zoo.server._tables.items()):
@@ -347,6 +362,7 @@ class _RemoteMatrixWorker(MatrixWorker):
         self.is_sparse = bool(spec.get("is_sparse", False))
         self._cache = (np.zeros((self.num_row, self.num_col), self.dtype)
                        if self.is_sparse else None)
+        self.rows_pulled = 0
 
     def get_device(self):
         raise RuntimeError("get_device() needs mesh residency; remote "

@@ -93,7 +93,12 @@ class DeviceKVServer(ServerTable):
                       "types", self.value_dtype)
         self.mesh = zoo.mesh
         axis = self.mesh.axis_names[0]
-        self.num_shards = zoo.num_servers
+        # shards = the size of the ONE mesh axis the shard_map below indexes
+        # (axis 0). On a multi-axis table mesh, devices off axis 0 replicate:
+        # using zoo.num_servers (total device count) here would make
+        # `key % num_shards == axis_index` silently drop every key with
+        # residue >= the axis size.
+        self.num_shards = int(self.mesh.shape[axis])
         per = max(64, -(-int(capacity) // self.num_shards))
         per = 1 << (per - 1).bit_length()  # pow2 per-shard capacity
         self.shard_capacity = per

@@ -38,10 +38,7 @@ from multiverso_tpu.runtime.zoo import Zoo
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
 from multiverso_tpu.tables.array_table import _make_whole_update
 from multiverso_tpu.updaters import AddOption, GetOption, SGDUpdater, Updater, get_updater
-
-
-def _next_pow2(n: int) -> int:
-    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+from multiverso_tpu.utils import next_pow2 as _next_pow2
 
 
 def _use_pallas_scatter(backend: str, num_shards: int) -> bool:
@@ -196,26 +193,33 @@ class MatrixServer(ServerTable):
                 else:
                     self._up_to_date[:, touched] = False
 
+    def _is_worker(self, option) -> bool:
+        """Administrative access (worker id outside [0, num_workers), e.g.
+        checkpoint reads on a server-only node) must not touch any worker's
+        staleness bitmap — aliasing it onto slot 0 would serve worker 0
+        stale rows from its client cache (mirrors SyncServer._is_admin)."""
+        return option is not None and 0 <= option.worker_id < self.num_workers
+
     def process_get(self, request):
         row_ids, option = request
         if row_ids is None:
-            if self.is_sparse and option is not None:
+            if self.is_sparse and self._is_worker(option):
                 return self._sparse_get(option)
+            # admin whole-table reads take the dense path
             out = self.updater.access(self.data)
             return np.asarray(jax.device_get(out))[: self.num_row, : self.num_col]
         row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
         ids_p, _, n = self._bucket_ids(row_ids, None)
         rows = np.asarray(jax.device_get(
             self._gather(self.data, ids_p)))[:n, : self.num_col]
-        if self.is_sparse and option is not None:
+        if self.is_sparse and self._is_worker(option):
             with self._std_lock:
-                self._up_to_date[max(option.worker_id, 0) % self.num_workers,
-                                 row_ids] = True
+                self._up_to_date[option.worker_id, row_ids] = True
         return rows
 
     def _sparse_get(self, option: GetOption):
         """Return only the rows stale for this worker: (ids, rows)."""
-        w = max(option.worker_id, 0) % self.num_workers
+        w = option.worker_id
         with self._std_lock:
             stale = np.where(~self._up_to_date[w])[0].astype(np.int32)
             self._up_to_date[w, stale] = True
@@ -270,6 +274,9 @@ class MatrixWorker(WorkerTable):
         self._cache: Optional[np.ndarray] = None
         if self.is_sparse:
             self._cache = np.zeros((self.num_row, self.num_col), dtype=self.dtype)
+        # observability: rows actually fetched from the server by this proxy
+        # (the resource candidate-row pulls exist to bound — tests assert on it)
+        self.rows_pulled = 0
 
     # -- get ---------------------------------------------------------------
     def get(self, row_ids: Optional[np.ndarray] = None,
@@ -290,11 +297,27 @@ class MatrixWorker(WorkerTable):
         return self._finish_get(self.wait(msg_id), row_ids)
 
     def _finish_get(self, raw, row_ids) -> np.ndarray:
+        if self.is_sparse and row_ids is None and isinstance(raw, np.ndarray):
+            # admin-bypass reply (worker id out of range): dense whole table,
+            # no staleness bookkeeping — do not touch the client cache
+            self.rows_pulled += self.num_row
+            return raw
         if self.is_sparse and row_ids is None:
             stale_ids, rows = raw
             if len(stale_ids):
                 self._cache[stale_ids] = rows
+            self.rows_pulled += len(stale_ids)
             return np.array(self._cache, copy=True)
+        if row_ids is None:
+            self.rows_pulled += self.num_row
+            return raw
+        ids = np.asarray(row_ids).reshape(-1)
+        self.rows_pulled += len(ids)
+        if self.is_sparse:
+            # the server marked these rows fresh for this worker — mirror
+            # them into the client cache or a later whole-table sparse get
+            # would serve stale values for exactly these rows
+            self._cache[ids] = raw
         return raw
 
     # -- add ---------------------------------------------------------------

@@ -21,6 +21,12 @@ from typing import Callable, Deque, Generic, Optional, TypeVar
 T = TypeVar("T")
 
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n<=1 -> 1) — the shared bucket rounding
+    used by table id-batches, compact PS models, and KV capacities."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
 class MtQueue(Generic[T]):
     """Blocking multi-producer/multi-consumer queue with exit poison."""
 

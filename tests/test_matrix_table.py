@@ -96,6 +96,33 @@ def test_sparse_get_returns_only_stale_rows(mv_env):
     assert table._server_table._up_to_date[0].all()
 
 
+def test_sparse_admin_get_bypasses_staleness(mv_env):
+    """Administrative reads (worker id out of [0, num_workers), e.g. a
+    checkpoint read on a server-only node) must not alias worker slot 0's
+    staleness bitmap: they take the dense path and consume nothing."""
+    table = mv.create_table("matrix", 6, 2, np.float32, is_sparse=True)
+    table.add(np.ones((6, 2), np.float32))
+    raw = table.get(option=mv.GetOption(worker_id=-1))
+    assert isinstance(raw, np.ndarray)
+    np.testing.assert_allclose(raw, np.ones((6, 2)))
+    # slot 0's bitmap untouched: worker 0 still sees every row stale
+    assert not table._server_table._up_to_date[0].any()
+    np.testing.assert_allclose(table.get(), np.ones((6, 2)))
+    assert table._server_table._up_to_date[0].all()
+
+
+def test_sparse_row_subset_get_updates_client_cache(mv_env):
+    """A row-subset get marks rows fresh server-side, so the client MUST fold
+    the returned rows into its cache — otherwise the next whole-table sparse
+    get serves stale values for exactly those rows."""
+    table = mv.create_table("matrix", 5, 2, np.float32, is_sparse=True)
+    table.add(np.ones((5, 2), np.float32))
+    rows = table.get(row_ids=np.array([2]))
+    np.testing.assert_allclose(rows, [[1.0, 1.0]])
+    full = table.get()  # row 2 is fresh server-side; cache must agree
+    np.testing.assert_allclose(full, np.ones((5, 2)))
+
+
 def test_sparse_get_empty_when_fresh(mv_env):
     table = mv.create_table("matrix", 4, 2, np.float32, is_sparse=True)
     table.get()  # everything fresh now

@@ -237,6 +237,29 @@ def test_remote_reconnect_recycles_worker_slot():
     mv.shutdown()
 
 
+def test_remote_bogus_deregister_ignored():
+    """A deregister for a slot that is not currently leased (src=-1, a local
+    worker id, or a replay) must not enter the free list — otherwise two
+    later clients could share one worker id."""
+    from multiverso_tpu.runtime.message import Message, MsgType
+    from multiverso_tpu.runtime.zoo import Zoo
+    mv.init(remote_workers=2)
+    mv.create_table("array", 4, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    rs = Zoo.instance().remote_server
+    c1 = mv.remote_connect(endpoint)
+    rs._handle(Message(src=-1, dst=0, type=MsgType.Control_Deregister,
+                       msg_id=1), False)
+    rs._handle(Message(src=0, dst=0, type=MsgType.Control_Deregister,
+                       msg_id=2), False)
+    assert rs._free_slots == []
+    c2 = mv.remote_connect(endpoint)
+    assert c2.worker_id != c1.worker_id
+    c1.close()
+    c2.close()
+    mv.shutdown()
+
+
 # -- BSP across the wire -----------------------------------------------------
 
 def test_remote_bsp_contract():
