@@ -180,21 +180,44 @@ class LogReg:
 
 
 class PSLogReg(LogReg):
-    """Parameter-server mode: weights live in an ArrayTable (sgd/sigmoid/
-    softmax) or an FTRLTable; local replica syncs every ``sync_frequency``
-    minibatches, optionally via a prefetch double buffer
-    (reference: ``ps_model.cpp:172-271`` GetPipelineTable)."""
+    """Parameter-server mode: weights live in an ArrayTable (dense), a
+    SparseTable keyed by feature id (``config.sparse`` — pushes are O(nnz),
+    the reference's ``SparseWorkerTable`` contract), or an FTRL table (dense
+    accumulator or sparse struct-valued); the local replica syncs every
+    ``sync_frequency`` minibatches, optionally via a prefetch double buffer
+    (reference: ``ps_model.cpp:172-271`` GetPipelineTable, ``UpdateTable``'s
+    sparse branch ``ps_model.cpp:184-200``)."""
 
     def __init__(self, config: LogRegConfig) -> None:
         import multiverso_tpu as mv
         self.config = config
         self._n = config.output_size * (config.input_size + 1)
+        self._bias_key = config.input_size
         gl = _grad_and_loss(config)
         reg = _regularizer_grad(config)
         self._gl = jax.jit(gl)
         self._reg = jax.jit(reg)
         self._predict = jax.jit(self._predict_fn(gl))
-        if config.objective == "ftrl":
+        # table selection (reference: CreateTable in ps_model.cpp — array /
+        # sparse / ftrl-sparse keyed on config). Sparse-key tables carry one
+        # OUTPUT COLUMN per feature key (width = output_size), so a touched
+        # feature ships output_size floats — never the I×O dense gradient.
+        if config.sparse:
+            from multiverso_tpu.tables.sparse_table import (SparseWorker,
+                                                            make_sparse_ftrl)
+            mv.register_table_type("sparse", SparseWorker)
+            mv.register_table_type("sparse_ftrl", make_sparse_ftrl)
+            keys = config.input_size + 1  # + bias key
+            if config.objective == "ftrl":
+                self.table = mv.create_table(
+                    "sparse_ftrl", keys, width=config.output_size,
+                    alpha=config.alpha, beta=config.beta,
+                    lambda1=config.lambda1, lambda2=config.lambda2)
+            else:
+                self.table = mv.create_table(
+                    "sparse", keys, width=config.output_size,
+                    updater_type="sgd")
+        elif config.objective == "ftrl":
             from multiverso_tpu.tables.ftrl_table import FTRLWorker
             mv.register_table_type("ftrl", FTRLWorker)
             self.table = mv.create_table(
@@ -206,22 +229,48 @@ class PSLogReg(LogReg):
         self.w = jnp.asarray(self._pull())
         self._batches_since_sync = 0
         self._pending_get: Optional[int] = None
+        self._pending_adds: list = []
+
+    def _to_w(self, raw) -> np.ndarray:
+        """Reconstruct the dense (O, I+1) replica from a table reply."""
+        o, cols = self.config.output_size, self.config.input_size + 1
+        if self.config.sparse:
+            keys, vals = raw
+            w = np.zeros((o, cols), np.float32)
+            if len(keys):
+                w[:, keys] = vals.T
+            return w
+        return np.asarray(raw).reshape(o, cols)
 
     def _pull(self) -> np.ndarray:
-        return self.table.get().reshape(self.config.output_size,
-                                        self.config.input_size + 1)
+        return self._to_w(self.table.get())
 
     def update(self, batch: Dict[str, np.ndarray],
                lr: Optional[float] = None) -> float:
         lr = self.config.lr if lr is None else lr
+        idx_np = np.asarray(batch["idx"]) if self.config.sparse else None
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         grad, loss = self._gl(self.w, batch)
         push = grad + self._reg(self.w)
-        if self.config.objective == "ftrl":
-            self.table.add_async(np.asarray(push).reshape(-1))
+        if self.config.sparse:
+            # O(nnz) push: only the minibatch's touched feature columns (+
+            # bias) cross the boundary (reference sparse_table.h AddAsync).
+            # Regularization is LAZY in sparse mode: a feature's L1/L2 decay
+            # is applied only when a batch touches it — the standard sparse-
+            # PS trade (decaying all I columns would make the push O(I·O))
+            touched = np.unique(idx_np[idx_np >= 0]).astype(np.int64)
+            keys = np.concatenate([touched, [self._bias_key]])
+            cols = np.asarray(push)[:, keys].T          # (nnz, O)
+            if self.config.objective == "ftrl":
+                mid = self.table.add_async(keys, cols)  # server runs FTRL
+            else:
+                mid = self.table.add_async(keys, lr * cols)  # sgd updater: -=
+        elif self.config.objective == "ftrl":
+            mid = self.table.add_async(np.asarray(push).reshape(-1))
         else:
             # sgd updater applies data -= delta: ship lr-scaled gradient
-            self.table.add_async(lr * np.asarray(push).reshape(-1))
+            mid = self.table.add_async(lr * np.asarray(push).reshape(-1))
+        self._pending_adds.append(mid)
         self._batches_since_sync += 1
         if self._batches_since_sync >= self.config.sync_frequency:
             self._sync()
@@ -229,11 +278,16 @@ class PSLogReg(LogReg):
 
     def _sync(self) -> None:
         self._batches_since_sync = 0
+        # drain outstanding add handles (the dispatcher has applied them
+        # before any later get — FIFO — but their completions must be
+        # reclaimed or the pending map grows for the whole run)
+        for mid in self._pending_adds:
+            self.table.wait(mid)
+        self._pending_adds.clear()
         with monitor("PS_LOGREG_PULL"):
             if self.config.pipeline and self._pending_get is not None:
                 raw = self.table.wait(self._pending_get)
-                self.w = jnp.asarray(
-                    np.asarray(raw).reshape(self.config.output_size, -1))
+                self.w = jnp.asarray(self._to_w(raw))
                 self._pending_get = self.table.get_async()
             elif self.config.pipeline:
                 self._pending_get = self.table.get_async()
@@ -242,6 +296,9 @@ class PSLogReg(LogReg):
                 self.w = jnp.asarray(self._pull())
 
     def finish(self) -> None:
+        for mid in self._pending_adds:
+            self.table.wait(mid)
+        self._pending_adds.clear()
         if self._pending_get is not None:
             self.table.wait(self._pending_get)
             self._pending_get = None

@@ -651,11 +651,15 @@ class PSTrainer:
             return 0.0
         out_tok, labels, mask = self._block_outputs(predict)
 
-        # candidate sets: exactly the rows this block trains
+        # candidate sets: exactly the rows this block trains; both pulls are
+        # issued before either is awaited so their round trips overlap (the
+        # remote path pays one RTT, not two)
         in_cand = np.unique(in_tok[in_tok >= 0]).astype(np.int32)
         out_cand = np.unique(out_tok[out_tok >= 0]).astype(np.int32)
-        cached_in = self.input_table.get(in_cand)
-        cached_out = self.output_table.get(out_cand)
+        h_in = self.input_table.get_async(in_cand)
+        h_out = self.output_table.get_async(out_cand)
+        cached_in = self.input_table.wait_get(h_in, in_cand)
+        cached_out = self.output_table.wait_get(h_out, out_cand)
 
         # compact matrices: pow2 row buckets with a sentinel scratch row so
         # jit traces are reused across blocks of different candidate counts
@@ -707,11 +711,16 @@ class PSTrainer:
             from multiverso_tpu.updaters import AddOption
             opt = AddOption(worker_id=self.input_table._channel.worker_id(),
                             learning_rate=lr)
-            self.input_table.add(-delta_in / lr, row_ids=in_cand, option=opt)
-            self.output_table.add(-delta_out / lr, row_ids=out_cand, option=opt)
+            a1 = self.input_table.add_async(-delta_in / lr, row_ids=in_cand,
+                                            option=opt)
+            a2 = self.output_table.add_async(-delta_out / lr,
+                                             row_ids=out_cand, option=opt)
         else:
-            self.input_table.add(delta_in, row_ids=in_cand)
-            self.output_table.add(delta_out, row_ids=out_cand)
+            a1 = self.input_table.add_async(delta_in, row_ids=in_cand)
+            a2 = self.output_table.add_async(delta_out, row_ids=out_cand)
+        # overlapped pushes; waits reclaim the completions
+        self.input_table.wait(a1)
+        self.output_table.wait(a2)
         self.count_table.add([0], [int(len(block))])
         self.words_trained += len(block)
         self.last_block_stats = {"in_rows": n_in, "out_rows": n_out,

@@ -39,6 +39,7 @@ from multiverso_tpu.tables.array_table import ArrayWorker
 from multiverso_tpu.tables.base import Completion, WorkerTable
 from multiverso_tpu.tables.kv_table import KVWorker
 from multiverso_tpu.tables.matrix_table import MatrixWorker
+from multiverso_tpu.tables.sparse_table import SparseWorker
 
 config.define_bool("wire_compression", True,
                    "SparseFilter-compress float32 payloads on host hops "
@@ -89,7 +90,10 @@ class RemoteServer:
         self._wid_lock = threading.Lock()
         self._next_remote = 0
         self._free_slots: List[int] = []  # recycled by Control_Deregister
-        self._leased: set = set()         # slots currently held by a client
+        # slot -> the connection that registered it: a deregister is honored
+        # only from that connection, so a replayed/forged deregister cannot
+        # free a slot that was re-leased to a different client
+        self._leased: Dict[int, Any] = {}
         self.endpoint: Optional[str] = None
 
     def serve(self, endpoint: str = "127.0.0.1:0") -> str:
@@ -132,22 +136,25 @@ class RemoteServer:
             # history a newcomer must not inherit, so BSP keeps the
             # reference's static-membership contract (a departed worker's
             # slot stays retired; crashed clients are never reclaimed).
-            # Only a currently-leased remote slot is accepted: a duplicate or
-            # bogus deregister (src=-1, a local id, a replay) must not let
-            # two later clients share one worker id. A recycled slot DOES
-            # inherit the departed client's per-worker updater state
-            # (momentum/adagrad accumulators) — deliberate: that state is
-            # the slot's optimization history, exactly what the reference's
-            # static membership kept positional.
+            # Only the connection that leased the slot may free it: a
+            # duplicate, forged, or replayed deregister (src=-1, a local id,
+            # a replay after the slot was re-leased) must not let two later
+            # clients share one worker id. A recycled slot DOES inherit the
+            # departed client's per-worker updater state (momentum/adagrad
+            # accumulators) — deliberate: that state is the slot's
+            # optimization history, exactly what the reference's static
+            # membership kept positional.
             from multiverso_tpu.runtime.server import SyncServer
             if not isinstance(self._zoo.server, SyncServer):
                 with self._wid_lock:
-                    if int(msg.src) in self._leased:
-                        self._leased.discard(int(msg.src))
-                        self._free_slots.append(int(msg.src))
+                    slot = int(msg.src)
+                    conn = getattr(msg, "_conn", None)
+                    if conn is not None and self._leased.get(slot) is conn:
+                        del self._leased[slot]
+                        self._free_slots.append(slot)
                     else:
                         log.error("remote: ignoring deregister for slot %d "
-                                  "(not currently leased)", int(msg.src))
+                                  "(not leased to this connection)", slot)
             return
         if msg.type == MsgType.Server_Finish_Train:
             self._zoo.server.send(Message(
@@ -168,7 +175,7 @@ class RemoteServer:
         with self._wid_lock:
             if self._free_slots:
                 worker_id = self._free_slots.pop()
-                self._leased.add(worker_id)
+                self._leased[worker_id] = msg._conn
             elif self._next_remote >= self._zoo.remote_workers:
                 # refuse: an out-of-range worker id would alias slot-0
                 # per-worker state and bypass the BSP clocks
@@ -184,7 +191,7 @@ class RemoteServer:
             else:
                 worker_id = base + self._next_remote
                 self._next_remote += 1
-                self._leased.add(worker_id)
+                self._leased[worker_id] = msg._conn
         directory = []
         # snapshot: create_table on the main thread mutates the dict
         for table_id, table in list(self._zoo.server._tables.items()):
@@ -329,6 +336,8 @@ class RemoteClient:
             return _RemoteMatrixWorker(spec, table_id, self._channel)
         if kind == "kv":
             return _RemoteKVWorker(spec, table_id, self._channel)
+        if kind == "sparse":
+            return _RemoteSparseWorker(spec, table_id, self._channel)
         raise KeyError(f"unknown remote table kind {kind!r}")
 
     def tables(self) -> List[WorkerTable]:
@@ -375,3 +384,16 @@ class _RemoteKVWorker(KVWorker):
         self.table_id = table_id
         self.value_dtype = np.dtype(spec["dtype"])
         self._raw: Dict[int, Any] = {}
+
+
+class _RemoteSparseWorker(SparseWorker):
+    """Sparse-key table shaping (O(nnz) get/add, counters) over the wire."""
+
+    def __init__(self, spec, table_id: int, channel: RemoteChannel) -> None:
+        WorkerTable.__init__(self, channel=channel)
+        self.table_id = table_id
+        self.key_space = int(spec["key_space"])
+        self.width = int(spec["width"])
+        self.dtype = np.dtype(spec["dtype"])
+        self.elements_pushed = 0
+        self.elements_pulled = 0

@@ -27,6 +27,7 @@ import numpy as np
 
 from multiverso_tpu import log
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
+from multiverso_tpu.utils import next_pow2
 
 
 class KVServer(ServerTable):
@@ -99,8 +100,7 @@ class DeviceKVServer(ServerTable):
         # `key % num_shards == axis_index` silently drop every key with
         # residue >= the axis size.
         self.num_shards = int(self.mesh.shape[axis])
-        per = max(64, -(-int(capacity) // self.num_shards))
-        per = 1 << (per - 1).bit_length()  # pow2 per-shard capacity
+        per = next_pow2(max(64, -(-int(capacity) // self.num_shards)))
         self.shard_capacity = per
         self.capacity = per * self.num_shards
 
@@ -139,7 +139,7 @@ class DeviceKVServer(ServerTable):
 
     @staticmethod
     def _bucket(arr: np.ndarray, fill, dtype) -> np.ndarray:
-        n = max(64, 1 << (max(len(arr), 1) - 1).bit_length())
+        n = max(64, next_pow2(len(arr)))
         out = np.full(n, fill, dtype)
         out[: len(arr)] = arr
         return out
