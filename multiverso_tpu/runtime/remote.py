@@ -369,9 +369,8 @@ class _RemoteMatrixWorker(MatrixWorker):
         self.num_col = int(spec["num_col"])
         self.dtype = np.dtype(spec["dtype"])
         self.is_sparse = bool(spec.get("is_sparse", False))
-        self._cache = (np.zeros((self.num_row, self.num_col), self.dtype)
-                       if self.is_sparse else None)
-        self.rows_pulled = 0
+        self._init_client_state(bool(spec.get("is_pipelined", False)),
+                                int(spec.get("num_workers", 1)))
 
     def get_device(self):
         raise RuntimeError("get_device() needs mesh residency; remote "

@@ -53,7 +53,8 @@ class MatrixServer(ServerTable):
                  updater_type: str = "", num_workers: Optional[int] = None,
                  init_value: Optional[np.ndarray] = None,
                  init_range: Optional[Tuple[float, float]] = None,
-                 seed: int = 0, is_sparse: bool = False) -> None:
+                 seed: int = 0, is_sparse: bool = False,
+                 is_pipelined: Optional[bool] = None) -> None:
         super().__init__()
         zoo = Zoo.instance()
         self.num_row = int(num_row)
@@ -95,10 +96,20 @@ class MatrixServer(ServerTable):
             self.states[name] = jax.device_put(
                 np.zeros((worker_dim,) + tuple(shape_suffix), dtype=sdtype), s_shard)
 
-        # staleness metadata (gen-2 `up_to_date_`): host-side control plane
+        # staleness metadata (gen-2 `up_to_date_`): host-side control plane.
+        # is_pipelined doubles the planes (reference matrix.cpp:407-418):
+        # each worker owns TWO staleness identities — worker_id and
+        # worker_id + num_workers — which its double-buffered client
+        # alternates between, so an in-flight pipelined Get and the next Get
+        # each track their own stale set.
         self.is_sparse = bool(is_sparse)
+        if is_pipelined is None:
+            from multiverso_tpu import config as config_mod
+            is_pipelined = bool(config_mod.get_flag("is_pipelined"))
+        self.is_pipelined = bool(is_pipelined)
         if self.is_sparse:
-            self._up_to_date = np.zeros((self.num_workers, self.num_row), dtype=bool)
+            self.num_slots = self.num_workers * (2 if self.is_pipelined else 1)
+            self._up_to_date = np.zeros((self.num_slots, self.num_row), dtype=bool)
             self._std_lock = threading.Lock()
 
         self._whole_update = _make_whole_update(self.updater)
@@ -194,11 +205,12 @@ class MatrixServer(ServerTable):
                     self._up_to_date[:, touched] = False
 
     def _is_worker(self, option) -> bool:
-        """Administrative access (worker id outside [0, num_workers), e.g.
+        """Administrative access (worker id outside [0, num_slots), e.g.
         checkpoint reads on a server-only node) must not touch any worker's
         staleness bitmap — aliasing it onto slot 0 would serve worker 0
-        stale rows from its client cache (mirrors SyncServer._is_admin)."""
-        return option is not None and 0 <= option.worker_id < self.num_workers
+        stale rows from its client cache (mirrors SyncServer._is_admin).
+        num_slots covers the pipelined second plane (worker_id+num_workers)."""
+        return option is not None and 0 <= option.worker_id < self.num_slots
 
     def process_get(self, request):
         row_ids, option = request
@@ -236,7 +248,9 @@ class MatrixServer(ServerTable):
     def remote_spec(self):
         return {"kind": "matrix", "num_row": self.num_row,
                 "num_col": self.num_col, "dtype": self.dtype.str,
-                "is_sparse": self.is_sparse}
+                "is_sparse": self.is_sparse,
+                "is_pipelined": self.is_pipelined,
+                "num_workers": self.num_workers}
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
@@ -261,6 +275,7 @@ class MatrixWorker(WorkerTable):
                  updater_type: str = "", init_value: Optional[np.ndarray] = None,
                  init_range: Optional[Tuple[float, float]] = None,
                  is_sparse: bool = False, seed: int = 0,
+                 is_pipelined: Optional[bool] = None,
                  server: Optional[MatrixServer] = None) -> None:
         super().__init__()
         self.num_row = int(num_row)
@@ -269,11 +284,24 @@ class MatrixWorker(WorkerTable):
         self.is_sparse = bool(is_sparse)
         self._server_table = server or MatrixServer(
             num_row, num_col, dtype, updater_type, init_value=init_value,
-            init_range=init_range, seed=seed, is_sparse=is_sparse)
+            init_range=init_range, seed=seed, is_sparse=is_sparse,
+            is_pipelined=is_pipelined)
         self._register(self._server_table)
-        self._cache: Optional[np.ndarray] = None
-        if self.is_sparse:
-            self._cache = np.zeros((self.num_row, self.num_col), dtype=self.dtype)
+        self._init_client_state(self._server_table.is_pipelined
+                                if self.is_sparse else False,
+                                self._server_table.num_workers)
+
+    def _init_client_state(self, pipelined: bool, num_workers: int) -> None:
+        """Sparse-mode client caches: one per staleness plane. In pipelined
+        mode whole-table Gets alternate planes so an in-flight prefetch and
+        the next Get never consume each other's stale sets."""
+        self._pipelined = bool(pipelined)
+        self._num_workers = int(num_workers)
+        self._n_phases = 2 if self._pipelined else 1
+        self._caches = [np.zeros((self.num_row, self.num_col), self.dtype)
+                        for _ in range(self._n_phases)] if self.is_sparse else []
+        self._phase = 0
+        self._phase_of: Dict[int, int] = {}  # msg_id -> phase (async gets)
         # observability: rows actually fetched from the server by this proxy
         # (the resource candidate-row pulls exist to bound — tests assert on it)
         self.rows_pulled = 0
@@ -281,22 +309,41 @@ class MatrixWorker(WorkerTable):
     # -- get ---------------------------------------------------------------
     def get(self, row_ids: Optional[np.ndarray] = None,
             option: Optional[GetOption] = None) -> np.ndarray:
-        option = self._default_get_option(option)
+        option, phase = self._prep_get_option(option, row_ids)
         raw = super().get((self._norm_ids(row_ids), option))
-        return self._finish_get(raw, row_ids)
+        return self._finish_get(raw, row_ids, phase)
 
     def get_async(self, row_ids: Optional[np.ndarray] = None,
                   option: Optional[GetOption] = None) -> int:
-        option = self._default_get_option(option)
-        return super().get_async((self._norm_ids(row_ids), option))
+        option, phase = self._prep_get_option(option, row_ids)
+        msg_id = super().get_async((self._norm_ids(row_ids), option))
+        self._phase_of[msg_id] = phase
+        return msg_id
 
     def process_reply_get(self, raw, request):
         return raw
 
     def wait_get(self, msg_id: int, row_ids: Optional[np.ndarray] = None) -> np.ndarray:
-        return self._finish_get(self.wait(msg_id), row_ids)
+        phase = self._phase_of.pop(msg_id, 0)
+        return self._finish_get(self.wait(msg_id), row_ids, phase)
 
-    def _finish_get(self, raw, row_ids) -> np.ndarray:
+    def _prep_get_option(self, option: Optional[GetOption],
+                         row_ids) -> Tuple[GetOption, int]:
+        """Default option + pipelined plane selection: whole-table sparse
+        Gets alternate between the worker's two staleness identities
+        (worker_id, worker_id + num_workers — reference matrix.cpp:407-418)."""
+        phase = 0
+        if option is None:
+            wid = self._channel.worker_id()
+            if (self.is_sparse and self._pipelined and row_ids is None
+                    and 0 <= wid < self._num_workers):
+                phase = self._phase
+                self._phase = 1 - self._phase
+                wid += phase * self._num_workers
+            option = GetOption(worker_id=wid)
+        return option, phase
+
+    def _finish_get(self, raw, row_ids, phase: int = 0) -> np.ndarray:
         if self.is_sparse and row_ids is None and isinstance(raw, np.ndarray):
             # admin-bypass reply (worker id out of range): dense whole table,
             # no staleness bookkeeping — do not touch the client cache
@@ -304,30 +351,46 @@ class MatrixWorker(WorkerTable):
             return raw
         if self.is_sparse and row_ids is None:
             stale_ids, rows = raw
+            cache = self._caches[phase]
             if len(stale_ids):
-                self._cache[stale_ids] = rows
+                cache[stale_ids] = rows
             self.rows_pulled += len(stale_ids)
-            return np.array(self._cache, copy=True)
+            return np.array(cache, copy=True)
         if row_ids is None:
             self.rows_pulled += self.num_row
             return raw
         ids = np.asarray(row_ids).reshape(-1)
         self.rows_pulled += len(ids)
         if self.is_sparse:
-            # the server marked these rows fresh for this worker — mirror
-            # them into the client cache or a later whole-table sparse get
-            # would serve stale values for exactly these rows
-            self._cache[ids] = raw
+            # the server marked these rows fresh for this worker (plane 0) —
+            # mirror them into the plane-0 cache or a later whole-table
+            # sparse get would serve stale values for exactly these rows
+            self._caches[0][ids] = raw
         return raw
 
     # -- add ---------------------------------------------------------------
+    def _auto_sparse_rows(self, values, row_ids):
+        """Worker-side nonzero-row auto-detect (reference matrix.cpp:148-182):
+        a whole-table Add to a sparse table scans the delta and ships only
+        the nonzero rows — the caller keeps the dense API."""
+        if row_ids is not None or not self.is_sparse:
+            return row_ids, values
+        values = np.asarray(values, dtype=self.dtype).reshape(
+            self.num_row, self.num_col)
+        nz = np.nonzero(values.any(axis=1))[0].astype(np.int32)
+        if len(nz) == self.num_row:
+            return None, values
+        return nz, values[nz]
+
     def add(self, values: np.ndarray, row_ids: Optional[np.ndarray] = None,
             option: Optional[AddOption] = None) -> None:
+        row_ids, values = self._auto_sparse_rows(values, row_ids)
         option = self._default_add_option(option)
         super().add((self._norm_ids(row_ids), values, option))
 
     def add_async(self, values: np.ndarray, row_ids: Optional[np.ndarray] = None,
                   option: Optional[AddOption] = None) -> int:
+        row_ids, values = self._auto_sparse_rows(values, row_ids)
         option = self._default_add_option(option)
         return super().add_async((self._norm_ids(row_ids), values, option))
 

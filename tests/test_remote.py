@@ -237,6 +237,27 @@ def test_remote_reconnect_recycles_worker_slot():
     mv.shutdown()
 
 
+def test_remote_whole_add_ships_only_nonzero_rows():
+    """A remote client's whole-table Add with 3 touched rows crosses the
+    wire as exactly 3 rows (round-2 verdict task 3 done-criterion)."""
+    mv.init(remote_workers=1)
+    t = mv.create_table("matrix", 8, 2, np.float32, is_sparse=True)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.tables()[0]
+    seen = []
+    orig = t._server_table.process_add
+    t._server_table.process_add = lambda req: (seen.append(req[0]), orig(req))[1]
+    delta = np.zeros((8, 2), np.float32)
+    delta[[0, 4, 7]] = 1.0
+    rt.add(delta)
+    assert len(seen) == 1
+    np.testing.assert_array_equal(seen[0], [0, 4, 7])  # 3 rows, not 8
+    np.testing.assert_allclose(t.get(row_ids=[0, 4, 7]), np.ones((3, 2)))
+    client.close()
+    mv.shutdown()
+
+
 def test_remote_bogus_deregister_ignored():
     """A deregister for a slot that is not currently leased (src=-1, a local
     worker id, or a replay) must not enter the free list — otherwise two
