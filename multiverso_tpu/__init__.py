@@ -46,7 +46,35 @@ def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
         set_flag("sync", sync)
     for key, value in flag_overrides.items():
         set_flag(key, value)
-    return Zoo.instance().start(argv)
+    remaining = Zoo.instance().start(argv)
+    _configure_native_allocator()
+    return remaining
+
+
+def _configure_native_allocator() -> None:
+    """Plumb the ``allocator_type`` / ``allocator_alignment`` flags into the
+    native host pool (reference: the flags were read at allocator
+    construction, src/util/allocator.cpp:10,153). Too-late configuration
+    (something already allocated) is reported, not fatal."""
+    import ctypes
+    from multiverso_tpu.utils.quantization import _load_native
+    lib = _load_native()
+    if lib is None or not hasattr(lib, "MVTPU_ConfigureAllocator"):
+        return  # native lib absent or predates the configure export
+    lib.MVTPU_ConfigureAllocator.restype = ctypes.c_int
+    lib.MVTPU_ConfigureAllocator.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    kind = str(get_flag("allocator_type"))
+    rc = lib.MVTPU_ConfigureAllocator(
+        kind.encode(), int(get_flag("allocator_alignment")))
+    if rc == -1:
+        log.info("native allocator already instantiated; allocator_type=%s "
+                 "ignored for this process", kind)
+    elif rc == -2:
+        log.error("unknown allocator_type %r (want smart|default)", kind)
+    elif rc == -3:
+        log.error("allocator_alignment=%s is not a power of two >= %d; "
+                  "keeping the previous alignment",
+                  get_flag("allocator_alignment"), 8)
 
 
 def shutdown(finalize_net: bool = True) -> None:
@@ -169,10 +197,20 @@ def net_bind(rank: int, endpoint: str) -> str:
     return _raw_net.bind(rank, endpoint)
 
 
-def net_connect(endpoints: Sequence[str]) -> None:
-    """Provide the full rank→endpoint map; connections dial lazily."""
+def net_connect(endpoints: Optional[Sequence[str]] = None) -> None:
+    """Provide the full rank→endpoint map; connections dial lazily. With no
+    argument, the map is read from the ``machine_file`` flag (one host:port
+    per line — the reference ZMQ backend's ``ParseMachineFile`` contract,
+    zmq_net.h:234-254)."""
     if _raw_net is None:
         log.fatal("net_connect: call net_bind first")
+    if endpoints is None:
+        from multiverso_tpu.runtime.net import parse_machine_file
+        path = get_flag("machine_file")
+        if not path:
+            log.fatal("net_connect: no endpoints given and the machine_file "
+                      "flag is empty")
+        endpoints = parse_machine_file(path)
     _raw_net.connect(list(endpoints))
 
 

@@ -149,7 +149,12 @@ parse_cmd_flags = FLAGS.parse_cmd_flags
 define_string("ps_role", "default", "node role: worker|server|default(all)|none")
 define_bool("ma", False, "model-averaging mode: skip PS tables, aggregate() only")
 define_bool("sync", False, "synchronous (BSP) parameter server")
-define_double("backup_worker_ratio", 0.0, "fraction of workers treated as backups")
+define_double("backup_worker_ratio", 0.0,
+              "fraction of workers treated as backups: the BSP round gates "
+              "ignore the slowest floor(ratio*num_workers) workers' clocks")
+define_double("sync_stall_seconds", 30.0,
+              "BSP watchdog period: log which workers' clocks are holding a "
+              "round when deferred requests make no progress; 0 disables")
 define_string("updater_type", "default", "server-side optimizer: default|sgd|adagrad|momentum_sgd|dcasgd")
 define_int("omp_threads", 4, "host-side worker threads for CPU fallbacks")
 define_bool("is_pipelined", False, "double-buffered pipelined get")
@@ -159,4 +164,6 @@ define_string("machine_file", "", "multi-host machine list (external transport)"
 define_int("port", 55555, "external transport port")
 define_string("mesh_shape", "", "device mesh shape, e.g. '2x4'; empty = auto 1-D")
 define_string("mesh_axes", "server", "comma-separated mesh axis names")
-define_bool("deterministic", False, "force deterministic apply order in async mode")
+define_bool("deterministic", False,
+            "async PS applies adds in (round, worker_id) order so the final "
+            "table state is bitwise reproducible (DeterministicServer)")

@@ -56,6 +56,7 @@ char* DefaultAllocator::Alloc(size_t size) {
   Header* h = header_of(data);
   h->bucket = size;
   new (&h->refcount) std::atomic<int>(1);
+  live_.fetch_add(1);
   return data;
 }
 
@@ -63,6 +64,7 @@ void DefaultAllocator::Free(char* data) {
   if (data == nullptr) return;
   Header* h = header_of(data);
   if (h->refcount.fetch_sub(1) == 1) {
+    live_.fetch_sub(1);
     std::free(base_of(data));
   }
 }
@@ -129,15 +131,68 @@ void SmartAllocator::Refer(char* data) {
   header_of(data)->refcount.fetch_add(1);
 }
 
+// Singleton configuration: type/alignment are latched by the first Get();
+// MVTPU_ConfigureAllocator must run before any allocation (the Python side
+// calls it from mv.init() with the allocator_type/allocator_alignment flags).
+namespace {
+std::mutex g_singleton_mutex;
+std::atomic<Allocator*> g_instance{nullptr};
+bool g_smart = true;
+size_t g_alignment = 16;
+}  // namespace
+
 Allocator* Allocator::Get() {
-  static SmartAllocator instance;
-  return &instance;
+  Allocator* inst = g_instance.load(std::memory_order_acquire);
+  if (inst != nullptr) return inst;
+  std::lock_guard<std::mutex> lock(g_singleton_mutex);
+  inst = g_instance.load(std::memory_order_relaxed);
+  if (inst == nullptr) {
+    if (g_smart) {
+      inst = new SmartAllocator(g_alignment);
+    } else {
+      inst = new DefaultAllocator(g_alignment);
+    }
+    g_instance.store(inst, std::memory_order_release);
+  }
+  return inst;
 }
 
 }  // namespace mvtpu
 
 // Flat C exports for the ctypes binding / tests.
 extern "C" {
+
+// Returns 0 on success; -1 if the singleton already exists with a different
+// configuration (too late to change); -2 on an unknown type string; -3 on an
+// alignment posix_memalign would reject (not a power of two >= sizeof(void*))
+// — rejected here so a bad flag is a configure error, not a bad_alloc thrown
+// across the FFI boundary at first allocation.
+int MVTPU_ConfigureAllocator(const char* type, size_t alignment) {
+  bool smart;
+  if (std::strcmp(type, "smart") == 0) {
+    smart = true;
+  } else if (std::strcmp(type, "default") == 0) {
+    smart = false;
+  } else {
+    return -2;
+  }
+  if (alignment < sizeof(void*) || (alignment & (alignment - 1)) != 0) {
+    return -3;
+  }
+  std::lock_guard<std::mutex> lock(mvtpu::g_singleton_mutex);
+  if (mvtpu::g_instance.load() != nullptr) {
+    return (smart == mvtpu::g_smart && alignment == mvtpu::g_alignment) ? 0
+                                                                        : -1;
+  }
+  mvtpu::g_smart = smart;
+  mvtpu::g_alignment = alignment;
+  return 0;
+}
+
+const char* MVTPU_AllocatorType() {
+  std::lock_guard<std::mutex> lock(mvtpu::g_singleton_mutex);
+  return mvtpu::g_smart ? "smart" : "default";
+}
 
 void* MVTPU_Alloc(size_t size) { return mvtpu::Allocator::Get()->Alloc(size); }
 
@@ -150,13 +205,11 @@ void MVTPU_Refer(void* data) {
 }
 
 size_t MVTPU_AllocatorLiveBlocks() {
-  return static_cast<mvtpu::SmartAllocator*>(mvtpu::Allocator::Get())
-      ->live_blocks();
+  return mvtpu::Allocator::Get()->live_blocks();
 }
 
 size_t MVTPU_AllocatorPooledBlocks() {
-  return static_cast<mvtpu::SmartAllocator*>(mvtpu::Allocator::Get())
-      ->pooled_blocks();
+  return mvtpu::Allocator::Get()->pooled_blocks();
 }
 
 }  // extern "C"

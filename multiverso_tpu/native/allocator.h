@@ -22,19 +22,27 @@ class Allocator {
   virtual char* Alloc(size_t size) = 0;
   virtual void Free(char* data) = 0;
   virtual void Refer(char* data) = 0;
-  static Allocator* Get();  // singleton keyed on allocator_type flag
+  virtual size_t live_blocks() const { return 0; }
+  virtual size_t pooled_blocks() const { return 0; }
+  // Singleton keyed on the allocator_type / allocator_alignment flags,
+  // plumbed from the Python registry via MVTPU_ConfigureAllocator before
+  // first use (reference: MV_CONFIG_allocator_type, allocator.cpp:153).
+  static Allocator* Get();
 };
 
-// Plain aligned allocator: header { atomic<int> refcount } before payload.
+// Plain aligned allocator: header { atomic<int> refcount } before payload;
+// Free releases memory immediately (no pooling).
 class DefaultAllocator : public Allocator {
  public:
   explicit DefaultAllocator(size_t alignment = 16) : alignment_(alignment) {}
   char* Alloc(size_t size) override;
   void Free(char* data) override;
   void Refer(char* data) override;
+  size_t live_blocks() const override { return live_.load(); }
 
  private:
   size_t alignment_;
+  std::atomic<size_t> live_{0};
 };
 
 // Size-bucketed pool: blocks are rounded up to powers of two (>= 32B) and
@@ -47,8 +55,8 @@ class SmartAllocator : public Allocator {
   void Free(char* data) override;
   void Refer(char* data) override;
 
-  size_t live_blocks() const { return live_.load(); }
-  size_t pooled_blocks() const { return pooled_.load(); }
+  size_t live_blocks() const override { return live_.load(); }
+  size_t pooled_blocks() const override { return pooled_.load(); }
 
  private:
   struct Impl;

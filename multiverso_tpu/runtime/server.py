@@ -93,19 +93,79 @@ class Server:
             log.error("server: unhandled message type %s", msg.type)
 
     def _process_add(self, msg: Message) -> None:
-        with monitor("WORKER_PROCESS_ADD_MSG"):
+        with monitor("SERVER_PROCESS_ADD_MSG"):
             request, completion = msg.data
             self._tables[msg.table_id].process_add(request)
             completion.done(None)
 
     def _process_get(self, msg: Message) -> None:
-        with monitor("WORKER_PROCESS_GET_MSG"):
+        with monitor("SERVER_PROCESS_GET_MSG"):
             request, completion = msg.data
             result = self._tables[msg.table_id].process_get(request)
             completion.done(result)
 
     def _process_finish_train(self, msg: Message) -> None:
         pass  # async server has no clocks to drain
+
+
+class DeterministicServer(Server):
+    """Async server with a deterministic apply order (the ``deterministic``
+    flag). Adds are buffered per (table, worker) and applied in
+    (round, worker_id) order: round-r deltas apply only once every unfinished
+    worker's round-r delta has arrived, then in ascending worker id. The final
+    table state is therefore bitwise reproducible run-to-run regardless of
+    thread scheduling (float addition is not associative; plain async applies
+    in arrival order). Gets are served immediately — reads stay async.
+
+    Contract: workers must issue the same number of adds per table between
+    ``finish_train`` calls (the lockstep-rounds shape BSP already imposes);
+    ``finish_train`` releases a finished worker's hold on later rounds.
+    Add completions fire at ENQUEUE, not apply (``add`` means "accepted;
+    will apply in deterministic order" — the same contract as
+    ``add_async``): completing at apply time would deadlock two workers
+    adding to two tables in opposite orders, each blocked waiting for the
+    round-mate add the other is about to send. Apply-time errors therefore
+    surface in the log, not in the caller (again like ``add_async``).
+    """
+
+    def __init__(self, num_workers: int) -> None:
+        super().__init__(num_workers)
+        self._add_queues: Dict[int, List[List[Message]]] = {}
+        self._det_finished: List[bool] = [False] * num_workers
+
+    def register_table(self, server_table) -> int:
+        table_id = super().register_table(server_table)
+        self._add_queues[table_id] = [[] for _ in range(self.num_workers)]
+        return table_id
+
+    def _process_add(self, msg: Message) -> None:
+        if not 0 <= msg.src < self.num_workers:
+            super()._process_add(msg)  # administrative: apply immediately
+            return
+        self._add_queues[msg.table_id][msg.src].append(msg)
+        msg.data[-1].done(None)  # accepted; applies in round order below
+        self._drain_adds(msg.table_id)
+
+    def _drain_adds(self, table_id: int) -> None:
+        queues = self._add_queues[table_id]
+        while any(queues) and all(
+                q or self._det_finished[w] for w, q in enumerate(queues)):
+            for w, q in enumerate(queues):
+                if q:
+                    request, _ = q.pop(0).data
+                    try:
+                        with monitor("SERVER_PROCESS_ADD_MSG"):
+                            self._tables[table_id].process_add(request)
+                    except Exception as exc:  # keep the round draining
+                        log.error("deterministic add from worker %d on table"
+                                  " %d failed at apply time: %r", w,
+                                  table_id, exc)
+
+    def _process_finish_train(self, msg: Message) -> None:
+        if 0 <= msg.src < self.num_workers:
+            self._det_finished[msg.src] = True
+        for tid in list(self._tables):
+            self._drain_adds(tid)
 
 
 class SyncServer(Server):
@@ -120,23 +180,104 @@ class SyncServer(Server):
         self._finished: List[bool] = [False] * num_workers
         self._pending_add: Dict[int, List[Message]] = {}
         self._pending_get: Dict[int, List[Message]] = {}
+        # Straggler tolerance: the reference defined `backup_worker_ratio`
+        # but never read it (src/server.cpp:21); here it is real — the
+        # slowest floor(ratio * num_workers) workers' clocks are ignored by
+        # the round gates, so backups can lag without stalling the ring.
+        self._backup_count = int(
+            config.get_flag("backup_worker_ratio") * num_workers)
+        # Stall watchdog (reference gap: peers hung silently on a crashed
+        # worker). Every `sync_stall_seconds` with no clock progress while
+        # requests sit deferred, log WHICH worker ids are holding the round.
+        self.last_stall: Optional[str] = None
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        # guards dict INSERTS (register_table, user thread) against the
+        # watchdog's iteration; in-place clock list mutation never resizes
+        self._register_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        super().start()
+        period = float(config.get_flag("sync_stall_seconds"))
+        if period > 0:
+            self._watch_thread = threading.Thread(
+                target=self._watch_stalls, args=(period,),
+                name="mv-sync-watchdog", daemon=True)
+            self._watch_thread.start()
+
+    def stop(self) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=10)
+            self._watch_thread = None
+        super().stop()
+
+    def _watch_stalls(self, period: float) -> None:
+        last_snap = None
+        while not self._watch_stop.wait(period):
+            with self._register_lock:
+                tids = list(self._add_clock)
+                snap_add = {t: list(self._add_clock[t]) for t in tids}
+                snap_get = {t: list(self._get_clock[t]) for t in tids}
+            pending = {tid: (len(self._pending_add[tid]),
+                             len(self._pending_get[tid]))
+                       for tid in tids}
+            snap = (snap_add, snap_get, pending)
+            if last_snap == snap and any(a or g for a, g in pending.values()):
+                for tid, (n_add, n_get) in pending.items():
+                    if not (n_add or n_get):
+                        continue
+                    adds, gets = self._add_clock[tid], self._get_clock[tid]
+                    # Blockers = unfinished workers at the minimum clock that
+                    # have NO deferred request of their own (a worker whose
+                    # request sits in the pending queue is waiting, not
+                    # holding the round).
+                    waiting = ({m.src for m in self._pending_add[tid]}
+                               | {m.src for m in self._pending_get[tid]})
+                    unfin = [w for w in range(self.num_workers)
+                             if not self._finished[w]]
+                    if not unfin:
+                        continue
+                    min_add = min(adds[w] for w in unfin)
+                    min_get = min(gets[w] for w in unfin)
+                    at_min = [w for w in unfin
+                              if adds[w] == min_add or gets[w] == min_get]
+                    lag = sorted(w for w in at_min if w not in waiting) \
+                        or sorted(at_min)
+                    report = (
+                        f"sync stall: table {tid} has {n_add} deferred adds /"
+                        f" {n_get} deferred gets with no progress for "
+                        f"{period:.1f}s; waiting on worker(s) {lag} "
+                        f"(add clocks {adds}, get clocks {gets})")
+                    self.last_stall = report
+                    log.error("%s", report)
+            last_snap = snap
 
     def register_table(self, server_table) -> int:
         table_id = super().register_table(server_table)
-        self._add_clock[table_id] = [0] * self.num_workers
-        self._get_clock[table_id] = [0] * self.num_workers
-        self._pending_add[table_id] = []
-        self._pending_get[table_id] = []
+        with self._register_lock:
+            self._add_clock[table_id] = [0] * self.num_workers
+            self._get_clock[table_id] = [0] * self.num_workers
+            self._pending_add[table_id] = []
+            self._pending_get[table_id] = []
         return table_id
 
-    # clock helpers: finished workers never hold anyone back
+    # clock helpers: finished workers never hold anyone back, and the
+    # slowest `_backup_count` unfinished workers are ignored (backup workers)
+    def _gate(self, vals: List[int]) -> int:
+        if not vals:
+            return 1 << 60
+        k = min(self._backup_count, len(vals) - 1)
+        return sorted(vals)[k]
+
     def _min_gets(self, table_id: int) -> int:
-        vals = [g for g, f in zip(self._get_clock[table_id], self._finished) if not f]
-        return min(vals) if vals else 1 << 60
+        return self._gate([g for g, f in zip(self._get_clock[table_id],
+                                             self._finished) if not f])
 
     def _min_adds(self, table_id: int) -> int:
-        vals = [a for a, f in zip(self._add_clock[table_id], self._finished) if not f]
-        return min(vals) if vals else 1 << 60
+        return self._gate([a for a, f in zip(self._add_clock[table_id],
+                                             self._finished) if not f])
 
     def _is_admin(self, worker: int) -> bool:
         """Administrative access (no worker context — e.g. checkpoint reads
@@ -219,7 +360,11 @@ class SyncServer(Server):
 
 
 def make_server(num_workers: int) -> Server:
-    """Factory keyed on the ``sync`` flag (reference: ``Server::GetServer``)."""
+    """Factory keyed on the ``sync`` flag (reference: ``Server::GetServer``);
+    the ``deterministic`` flag selects the reproducible-apply-order async
+    server (sync mode is already deterministic through its clocks)."""
     if config.get_flag("sync"):
         return SyncServer(num_workers)
+    if config.get_flag("deterministic"):
+        return DeterministicServer(num_workers)
     return Server(num_workers)

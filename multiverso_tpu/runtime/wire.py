@@ -24,6 +24,7 @@ from typing import Any, List
 
 import numpy as np
 
+from multiverso_tpu.dashboard import monitor
 from multiverso_tpu.updaters import AddOption, GetOption
 
 # arrays below this size never win from sparse encoding (header overhead)
@@ -31,7 +32,14 @@ _COMPRESS_MIN_SIZE = 64
 
 
 def encode(obj: Any, compress: bool = False) -> List[np.ndarray]:
-    """Structure -> [json-tree blob, ndarray blobs...]."""
+    """Structure -> [json-tree blob, ndarray blobs...]. Timed under the
+    WIRE_ENCODE monitor (the reference instrumented exactly its serialize
+    path, mpi_net.h:292)."""
+    with monitor("WIRE_ENCODE"):
+        return _encode(obj, compress)
+
+
+def _encode(obj: Any, compress: bool) -> List[np.ndarray]:
     blobs: List[np.ndarray] = []
 
     def enc(o: Any) -> Any:
@@ -92,6 +100,13 @@ def encode(obj: Any, compress: bool = False) -> List[np.ndarray]:
 
 
 def decode(blobs: List[np.ndarray]) -> Any:
+    """[json-tree blob, ndarray blobs...] -> structure (WIRE_DECODE monitor,
+    mirror of mpi_net.h:327's deserialize timer)."""
+    with monitor("WIRE_DECODE"):
+        return _decode(blobs)
+
+
+def _decode(blobs: List[np.ndarray]) -> Any:
     tree = json.loads(bytes(np.asarray(blobs[0], dtype=np.uint8)).decode())
     data = blobs[1:]
 

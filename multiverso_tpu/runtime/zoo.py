@@ -223,7 +223,17 @@ class Zoo:
         aggregate via the raw-net ring allreduce
         (:class:`multiverso_tpu.runtime.net.AllreduceEngine`)."""
         data = np.asarray(data)
-        slot = self.current_worker_id()
+        # Key by the calling thread's BOUND slot, not current_worker_id():
+        # on a ps_role=server node the worker id is -1 for every thread, so
+        # concurrent aggregates would silently overwrite one slot and return
+        # a wrong sum. The thread slot is role-independent.
+        slot = getattr(_thread_local, "worker_slot", None)
+        if slot is None and self._local_workers > 1:
+            log.fatal("aggregate: bind a worker slot (mv.worker(i)) before "
+                      "aggregating with local_workers=%d — an unbound thread "
+                      "cannot be distinguished from slot 0",
+                      self._local_workers)
+        slot = slot or 0
         with self._agg_lock:
             self._agg_slots[slot] = data
         if self._barrier is not None and self._local_workers > 1:

@@ -173,3 +173,18 @@ def test_async_buffer_prefetches():
     second = buf.get()[0]
     buf.stop()
     assert (first, second) == (1, 2)
+
+
+def test_wire_codec_is_monitored():
+    """The remote wire's serialize path is instrumented like the reference's
+    MPI serialize path (mpi_net.h:292,327)."""
+    import numpy as np
+
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.runtime import wire
+
+    payload = {"x": np.arange(8, dtype=np.float32), "n": 3}
+    out = wire.decode(wire.encode(payload))
+    np.testing.assert_array_equal(out["x"], payload["x"])
+    assert Dashboard.watch("WIRE_ENCODE").count == 1
+    assert Dashboard.watch("WIRE_DECODE").count == 1

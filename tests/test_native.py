@@ -5,6 +5,7 @@ real C client (subprocess), the allocator, and the SparseFilter codec
 import ctypes
 import os
 import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -98,3 +99,65 @@ def test_sparse_decode_rejects_garbage():
     from multiverso_tpu.utils import quantization as q
     with pytest.raises(ValueError):
         q.sparse_decode(b"garbagegarbagegarbage", 4, force_numpy=True)
+
+
+ALLOC_TYPE_SNIPPET = r"""
+import ctypes, sys
+lib = ctypes.CDLL(sys.argv[1])
+lib.MVTPU_ConfigureAllocator.restype = ctypes.c_int
+lib.MVTPU_ConfigureAllocator.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+lib.MVTPU_AllocatorType.restype = ctypes.c_char_p
+lib.MVTPU_Alloc.restype = ctypes.c_void_p
+lib.MVTPU_Alloc.argtypes = [ctypes.c_size_t]
+lib.MVTPU_Free.argtypes = [ctypes.c_void_p]
+assert lib.MVTPU_ConfigureAllocator(b"zzz", 16) == -2
+assert lib.MVTPU_ConfigureAllocator(b"default", 64) == 0
+assert lib.MVTPU_AllocatorType() == b"default"
+p = lib.MVTPU_Alloc(100)
+assert p % 64 == 0, "alignment flag not honored"
+assert lib.MVTPU_AllocatorLiveBlocks() == 1
+lib.MVTPU_Free(ctypes.c_void_p(p))
+# default allocator releases memory: nothing pooled, nothing live
+assert lib.MVTPU_AllocatorLiveBlocks() == 0
+assert lib.MVTPU_AllocatorPooledBlocks() == 0
+# reconfiguration after first use: same config ok, different config refused
+assert lib.MVTPU_ConfigureAllocator(b"default", 64) == 0
+assert lib.MVTPU_ConfigureAllocator(b"smart", 16) == -1
+print("alloc type ok")
+"""
+
+
+def test_allocator_type_flag(native_lib):
+    """allocator_type/allocator_alignment are real configuration: the
+    `default` allocator frees immediately (no pool) and honors alignment.
+    Run in a subprocess — the singleton latches on first use per process."""
+    result = subprocess.run(
+        [sys.executable, "-c", ALLOC_TYPE_SNIPPET, os.path.abspath(native_lib)],
+        capture_output=True, text=True, timeout=60)
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "alloc type ok" in result.stdout
+
+
+INIT_PLUMB_SNIPPET = r"""
+import ctypes, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[1])
+import multiverso_tpu as mv
+mv.init(allocator_type="default")
+from multiverso_tpu.utils.quantization import _load_native
+lib = _load_native()
+lib.MVTPU_AllocatorType.restype = ctypes.c_char_p
+assert lib.MVTPU_AllocatorType() == b"default", lib.MVTPU_AllocatorType()
+mv.shutdown()
+print("init plumb ok")
+"""
+
+
+def test_init_plumbs_allocator_flags(native_lib):
+    """mv.init() pushes the allocator flags into the native lib."""
+    repo = os.path.abspath(os.path.join(NATIVE_DIR, "..", ".."))
+    result = subprocess.run(
+        [sys.executable, "-c", INIT_PLUMB_SNIPPET, repo],
+        capture_output=True, text=True, timeout=120)
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "init plumb ok" in result.stdout

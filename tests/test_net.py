@@ -156,3 +156,31 @@ def test_machine_file(tmp_path):
 def test_get_local_ip():
     ip = get_local_ip()
     assert ip.count(".") == 3
+
+
+def test_net_connect_reads_machine_file_flag(tmp_path):
+    """MV_NetConnect with no endpoint list falls back to the machine_file
+    flag (reference ZMQ ParseMachineFile contract, zmq_net.h:234-254)."""
+    import multiverso_tpu as mv
+
+    f = tmp_path / "machines"
+    f.write_text("127.0.0.1:6001\n127.0.0.1:6002\n")
+    mv.set_flag("machine_file", str(f))
+    try:
+        mv.net_bind(0, "127.0.0.1:0")
+        mv.net_connect()  # no endpoints: read the flag
+        assert mv.net().size == 2
+        assert mv.net()._endpoints == ["127.0.0.1:6001", "127.0.0.1:6002"]
+    finally:
+        mv.net_finalize()
+
+
+def test_net_connect_without_machine_file_fatals():
+    import multiverso_tpu as mv
+
+    try:
+        mv.net_bind(0, "127.0.0.1:0")
+        with pytest.raises(mv.log.FatalError):
+            mv.net_connect()
+    finally:
+        mv.net_finalize()

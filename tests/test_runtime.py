@@ -91,3 +91,87 @@ def test_ma_mode_disables_tables():
     with pytest.raises(mv.log.FatalError):
         mv.create_table("array", 10)
     mv.shutdown()
+
+
+def test_aggregate_on_server_only_node():
+    """Regression: aggregate slots are keyed by the bound thread slot, not
+    current_worker_id() — on a ps_role=server node the worker id is -1 for
+    every thread and concurrent aggregates used to collide on one slot."""
+    mv.init(ps_role="server", local_workers=3)
+    results = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            results[slot] = mv.aggregate(
+                np.full(4, float(slot + 1), dtype=np.float32))
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    for r in results.values():
+        np.testing.assert_array_equal(r, np.full(4, 6.0, dtype=np.float32))
+    mv.shutdown()
+
+
+def test_aggregate_unbound_thread_fails_loudly():
+    """An unbound thread with local_workers>1 cannot be told apart from
+    slot 0 — aggregate must fatal, not silently collide."""
+    mv.init(ma=True, local_workers=2)
+    errors = {}
+
+    def run():
+        try:
+            mv.aggregate(np.ones(2, dtype=np.float32))
+        except mv.log.FatalError as exc:
+            errors["raised"] = str(exc)
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(timeout=10)
+    assert "bind a worker slot" in errors.get("raised", "")
+    mv.shutdown()
+
+
+def test_deterministic_server_apply_order():
+    """The `deterministic` flag: adds apply in (round, worker_id) order, so
+    the final fp32 table state is BITWISE equal to a serial application in
+    that order — regardless of thread scheduling (float addition is not
+    associative; plain async applies in arrival order)."""
+    import time
+
+    workers = 3
+    rounds = 4
+    rng = np.random.RandomState(7)
+    # magnitudes spread over 15 orders so fp32 summation order matters
+    deltas = (rng.uniform(-1.0, 1.0, (rounds, workers, 4))
+              * (10.0 ** rng.randint(-7, 8, (rounds, workers, 4)))
+              ).astype(np.float32)
+    expected = np.zeros(4, np.float32)
+    for r in range(rounds):
+        for w in range(workers):
+            expected = expected + deltas[r, w]  # serial (round, worker) order
+
+    mv.init(deterministic=True, local_workers=workers)
+    from multiverso_tpu.runtime.server import DeterministicServer
+    from multiverso_tpu.runtime.zoo import Zoo
+    assert isinstance(Zoo.instance().server, DeterministicServer)
+    table = mv.create_table("array", 4, np.float32)
+
+    def run(slot):
+        with mv.worker(slot):
+            for r in range(rounds):
+                # stagger arrival order away from worker order
+                time.sleep(0.01 * ((workers - slot) + r % 2))
+                table.add(deltas[r, slot])
+            table.finish_train()
+
+    threads = [threading.Thread(target=run, args=(s,)) for s in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    np.testing.assert_array_equal(table.get(), expected)
+    mv.shutdown()

@@ -85,6 +85,68 @@ def test_finish_train_releases_peers():
     mv.shutdown()
 
 
+def test_backup_worker_ratio_ignores_straggler():
+    """backup_worker_ratio=0.5 with 2 workers: the slowest worker's clocks
+    are ignored by the round gates, so the fast worker runs all its rounds
+    without the straggler ever participating (the flag the reference defined
+    but never read, src/server.cpp:21 — here it is real straggler
+    tolerance)."""
+    workers = 2
+    rounds = 4
+    mv.init(sync=True, local_workers=workers, backup_worker_ratio=0.5)
+    table = mv.create_table("array", 4, np.float32)
+    done = {}
+
+    def run(slot):
+        with mv.worker(slot):
+            if slot == 1:
+                return  # straggler: never adds, never gets
+            for i in range(rounds):
+                table.add(np.ones(4, np.float32))
+                val = table.get()
+                np.testing.assert_allclose(val, np.full(4, float(i + 1)))
+            done[slot] = True
+
+    _run_workers(workers, run)
+    assert done == {0: True}
+    mv.shutdown()
+
+
+def test_sync_stall_watchdog_names_lagging_worker():
+    """When a sync round stalls (a peer crashed or wedged), the watchdog
+    logs WHICH worker ids are holding the round — the reference died loudly
+    on send failure but peers of a wedged worker hung silently."""
+    import time
+
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    workers = 2
+    mv.init(sync=True, local_workers=workers, sync_stall_seconds=0.2)
+    table = mv.create_table("array", 4, np.float32)
+    server = Zoo.instance().server
+    assert server.last_stall is None
+
+    def run_fast():
+        with mv.worker(0):
+            table.add(np.ones(4, np.float32))
+            table.get()  # defers: worker 1's round-1 add never arrives
+
+    t = threading.Thread(target=run_fast)
+    t.start()
+    deadline = time.monotonic() + 10
+    while server.last_stall is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    stall = server.last_stall
+    assert stall is not None, "watchdog never fired"
+    assert "worker(s) [1]" in stall and "deferred gets" in stall
+    # release the stalled round so the thread can finish
+    with mv.worker(1):
+        table.finish_train()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    mv.shutdown()
+
+
 def test_async_mode_no_round_blocking(mv_env):
     """Async server: a single worker can run ahead freely."""
     table = mv.create_table("array", 4, np.float32)
