@@ -15,11 +15,9 @@ every N seconds or every N steps to ``<uri>/table_<id>.mvckpt``.
 
 from __future__ import annotations
 
-import os
 import struct
 import threading
-import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 
@@ -72,6 +70,9 @@ class CheckpointDriver:
     ``interval_steps``: snapshot on every Nth ``step()`` call;
     ``interval_seconds``: or on a wall-clock timer thread. Snapshots are
     written to ``<directory>/table_<id>.mvckpt`` with an atomic rename.
+    ``directory`` is a URI: any registered scheme works (``file://`` local,
+    ``mvfs://host:port/run`` remote — the reference checkpointed through its
+    Stream layer to local or HDFS storage the same way, io.cpp:8-23).
     """
 
     def __init__(self, tables: List, directory: str,
@@ -81,18 +82,23 @@ class CheckpointDriver:
         self.directory = directory
         self.interval_steps = interval_steps
         self.interval_seconds = interval_seconds
+        self._fs = mv_io.fs_for(directory)
         self._step = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        os.makedirs(directory, exist_ok=True)
+        self._fs.makedirs(directory)
         if interval_seconds:
             self._thread = threading.Thread(target=self._timer_loop, daemon=True)
             self._thread.start()
 
     def _timer_loop(self) -> None:
         while not self._stop.wait(self.interval_seconds):
-            self.snapshot()
+            try:
+                self.snapshot()
+            except Exception as exc:  # remote store down ≠ kill the timer
+                log.error("checkpoint: periodic snapshot to %s failed (%r); "
+                          "will retry next interval", self.directory, exc)
 
     def step(self) -> None:
         self._step += 1
@@ -104,10 +110,10 @@ class CheckpointDriver:
             for table in self.tables:
                 server = getattr(table, "_server_table", table)
                 tid = getattr(server, "table_id", 0)
-                final = os.path.join(self.directory, f"table_{tid}.mvckpt")
+                final = mv_io.join(self.directory, f"table_{tid}.mvckpt")
                 tmp = final + ".tmp"
                 store_table(table, tmp)
-                os.replace(tmp, final)
+                self._fs.replace(tmp, final)
             log.debug("checkpoint: snapshot of %d tables -> %s",
                       len(self.tables), self.directory)
 
@@ -118,8 +124,8 @@ class CheckpointDriver:
             for table in self.tables:
                 server = getattr(table, "_server_table", table)
                 tid = getattr(server, "table_id", 0)
-                path = os.path.join(self.directory, f"table_{tid}.mvckpt")
-                if os.path.exists(path):
+                path = mv_io.join(self.directory, f"table_{tid}.mvckpt")
+                if self._fs.exists(path):
                     load_table(table, path)
                     loaded = True
             return loaded

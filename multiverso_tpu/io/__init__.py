@@ -135,13 +135,144 @@ def register_scheme(scheme: str, factory: Callable[[URI, str], Stream]) -> None:
 register_scheme("file", lambda uri, mode: LocalStream(uri.path, mode))
 
 
+class FsspecStream(Stream):
+    """Cloud/object-store schemes (``gs://``, ``s3://``, ``memory://``, …)
+    through fsspec when it is importable — the deployment-gated analog of
+    the reference's compile-gated ``hdfs://`` (MULTIVERSO_USE_HDFS,
+    src/io/hdfs_stream.cpp). Engaged as the fallback factory for any scheme
+    fsspec knows; ``gs://`` additionally needs gcsfs + network at use time."""
+
+    def __init__(self, address: str, mode: str) -> None:
+        import fsspec  # gated: only reached when installed
+        binary_mode = mode if "b" in mode else mode + "b"
+        self._fp = None
+        try:
+            self._fp = fsspec.open(address, binary_mode).open()
+        except Exception as exc:  # missing backend, auth, network…
+            log.error("FsspecStream: cannot open %s (%s)", address, exc)
+
+    def write(self, data: bytes) -> int:
+        if self._fp is None:
+            log.fatal("FsspecStream.write on bad stream")
+        return self._fp.write(data)
+
+    def read(self, size: int = -1) -> bytes:
+        if self._fp is None:
+            log.fatal("FsspecStream.read on bad stream")
+        return self._fp.read(size)
+
+    def good(self) -> bool:
+        return self._fp is not None
+
+    def flush(self) -> None:
+        if self._fp is not None:
+            self._fp.flush()
+
+    def close(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+
+
+def _fsspec_known_scheme(scheme: str) -> bool:
+    try:
+        import fsspec
+        return scheme in fsspec.available_protocols()
+    except Exception:
+        return False
+
+
 def get_stream(address: str, mode: str = "r") -> Stream:
-    """StreamFactory::GetStream parity: dispatch on URI scheme."""
+    """StreamFactory::GetStream parity: dispatch on URI scheme; schemes not
+    registered explicitly fall back to fsspec when it can handle them."""
     uri = URI.parse(address)
     factory = _FACTORIES.get(uri.scheme)
     if factory is None:
+        if _fsspec_known_scheme(uri.scheme):
+            return FsspecStream(address, mode)
         log.fatal("Can not support the protocol: %s", uri.scheme)
     return factory(uri, mode)
+
+
+# -- filesystem operations (directory-level) ---------------------------------
+# The checkpoint driver needs more than streams: exists / atomic replace /
+# makedirs / listdir on whatever scheme the snapshot directory lives on.
+
+class FileSystem:
+    """Directory operations for one scheme (default impl: local files)."""
+
+    def exists(self, address: str) -> bool:
+        return os.path.exists(URI.parse(address).path)
+
+    def replace(self, src: str, dst: str) -> None:
+        """Atomic rename (the checkpoint commit step)."""
+        os.replace(URI.parse(src).path, URI.parse(dst).path)
+
+    def makedirs(self, address: str) -> None:
+        os.makedirs(URI.parse(address).path, exist_ok=True)
+
+    def listdir(self, address: str) -> list:
+        path = URI.parse(address).path
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+    def remove(self, address: str) -> None:
+        os.remove(URI.parse(address).path)
+
+
+class FsspecFileSystem(FileSystem):
+    """Directory ops for fsspec-served schemes. Note: ``replace`` is a
+    move, not an atomic rename — object stores (GCS/S3) have no atomic
+    rename; the checkpoint tmp+replace pattern degrades to last-writer-wins
+    there, which is the same contract the reference's HDFS path had."""
+
+    def __init__(self, scheme: str) -> None:
+        import fsspec
+        self._fs = fsspec.filesystem(scheme)
+
+    def exists(self, address: str) -> bool:
+        return self._fs.exists(address)
+
+    def replace(self, src: str, dst: str) -> None:
+        if self._fs.exists(dst):
+            self._fs.rm(dst)
+        self._fs.mv(src, dst)
+
+    def makedirs(self, address: str) -> None:
+        self._fs.makedirs(address, exist_ok=True)
+
+    def listdir(self, address: str) -> list:
+        return sorted(p.rsplit("/", 1)[-1]
+                      for p in self._fs.ls(address, detail=False))
+
+    def remove(self, address: str) -> None:
+        self._fs.rm(address)
+
+
+_FILESYSTEMS: Dict[str, FileSystem] = {"file": FileSystem()}
+
+
+def register_fs(scheme: str, fs: FileSystem) -> None:
+    _FILESYSTEMS[scheme] = fs
+
+
+def fs_for(address: str) -> FileSystem:
+    """FileSystem serving the address's scheme; unregistered schemes fall
+    back to fsspec when it knows them (matching get_stream's dispatch)."""
+    scheme = URI.parse(address).scheme
+    fs = _FILESYSTEMS.get(scheme)
+    if fs is None:
+        if _fsspec_known_scheme(scheme):
+            fs = _FILESYSTEMS[scheme] = FsspecFileSystem(scheme)
+        else:
+            log.fatal("no filesystem registered for: %s", address)
+    return fs
+
+
+def join(address: str, *names: str) -> str:
+    """Scheme-preserving path join (addresses always use '/')."""
+    base = address.rstrip("/")
+    tail = "/".join(n.strip("/") for n in names)
+    return f"{base}/{tail}" if tail else base
 
 
 class TextReader:
@@ -172,3 +303,8 @@ class TextReader:
 
     def close(self) -> None:
         self._stream.close()
+
+
+# Second storage scheme: socket-served remote filesystem (the hdfs:// analog).
+# Imported last — mvfs.py uses the names defined above.
+from multiverso_tpu.io import mvfs  # noqa: E402,F401

@@ -1,0 +1,186 @@
+"""Second storage scheme: the socket-served ``mvfs://`` remote filesystem
+(the reference's ``hdfs://`` analog, src/io/hdfs_stream.cpp:7-157) and the
+fsspec fallback for cloud schemes. Proves the Stream factory is a real
+dispatch seam and that CheckpointDriver snapshots THROUGH a remote scheme."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import io as mv_io
+from multiverso_tpu.checkpoint import CheckpointDriver, load_table, store_table
+from multiverso_tpu.io import TextReader
+from multiverso_tpu.io.mvfs import MvfsServer, reset_connections
+
+
+@pytest.fixture
+def mvfs(tmp_path):
+    server = MvfsServer(str(tmp_path / "export"))
+    endpoint = server.serve("127.0.0.1:0")
+    yield f"mvfs://{endpoint}"
+    reset_connections()
+    server.stop()
+
+
+def test_mvfs_stream_roundtrip(mvfs):
+    payload = bytes(range(256)) * 100
+    with mv_io.get_stream(f"{mvfs}/dir/data.bin", "w") as s:
+        assert s.good()
+        s.write(payload[:1000])
+        s.write(payload[1000:])
+    with mv_io.get_stream(f"{mvfs}/dir/data.bin", "r") as s:
+        assert s.read(100) == payload[:100]
+        assert s.read() == payload[100:]
+
+
+def test_mvfs_append_and_missing(mvfs):
+    with mv_io.get_stream(f"{mvfs}/log.txt", "w") as s:
+        s.write(b"one\n")
+    with mv_io.get_stream(f"{mvfs}/log.txt", "a") as s:
+        s.write(b"two\n")
+    with mv_io.get_stream(f"{mvfs}/log.txt", "r") as s:
+        assert s.read() == b"one\ntwo\n"
+    # missing file: bad stream, read fatals (LocalStream contract)
+    bad = mv_io.get_stream(f"{mvfs}/nope.bin", "r")
+    assert not bad.good()
+    with pytest.raises(mv.log.FatalError):
+        bad.read()
+
+
+def test_mvfs_write_commit_is_atomic(mvfs):
+    """An open write handle must not be visible at the final name until
+    close (temp + rename, the crash-safety contract)."""
+    fs = mv_io.fs_for(mvfs)
+    s = mv_io.get_stream(f"{mvfs}/atomic.bin", "w")
+    s.write(b"partial")
+    assert not fs.exists(f"{mvfs}/atomic.bin")
+    s.close()
+    assert fs.exists(f"{mvfs}/atomic.bin")
+
+
+def test_mvfs_filesystem_ops(mvfs):
+    fs = mv_io.fs_for(mvfs)
+    fs.makedirs(f"{mvfs}/sub")
+    with mv_io.get_stream(f"{mvfs}/sub/a.bin", "w") as s:
+        s.write(b"x")
+    assert fs.listdir(f"{mvfs}/sub") == ["a.bin"]
+    fs.replace(f"{mvfs}/sub/a.bin", f"{mvfs}/sub/b.bin")
+    assert fs.listdir(f"{mvfs}/sub") == ["b.bin"]
+    fs.remove(f"{mvfs}/sub/b.bin")
+    assert fs.listdir(f"{mvfs}/sub") == []
+
+
+def test_mvfs_rejects_path_escape(mvfs):
+    bad = mv_io.get_stream(f"{mvfs}/../evil.bin", "w")
+    assert not bad.good()
+
+
+def test_text_reader_over_mvfs(mvfs):
+    """TextReader is scheme-agnostic: line reading over the remote stream
+    (reference: TextReader rode Stream the same way, io.cpp:25-60)."""
+    with mv_io.get_stream(f"{mvfs}/corpus.txt", "w") as s:
+        s.write("first line\nsecond line\r\nthird".encode())
+    reader = TextReader(f"{mvfs}/corpus.txt")
+    assert reader.get_line() == "first line"
+    assert reader.get_line() == "second line"
+    assert reader.get_line() == "third"
+    assert reader.get_line() is None
+    reader.close()
+
+
+def test_matrix_table_store_load_through_mvfs(mv_env, mvfs):
+    """Table Store/Load across the remote scheme."""
+    table = mv.create_table("matrix", 6, 4, np.float32)
+    vals = np.random.default_rng(3).normal(size=(6, 4)).astype(np.float32)
+    table.add(vals)
+    store_table(table, f"{mvfs}/m.mvckpt")
+
+    fresh = mv.create_table("matrix", 6, 4, np.float32)
+    load_table(fresh, f"{mvfs}/m.mvckpt")
+    np.testing.assert_allclose(fresh.get(), vals, rtol=1e-6)
+
+
+def test_checkpoint_driver_through_mvfs(mv_env, mvfs):
+    """VERDICT r2 task 5 done-criterion: CheckpointDriver round-trips a
+    MatrixTable through the non-file scheme (snapshot + atomic replace +
+    restore, all as mvfs RPCs)."""
+    table = mv.create_table("matrix", 8, 4, np.float32)
+    vals = np.random.default_rng(5).normal(size=(8, 4)).astype(np.float32)
+    table.add(vals)
+    driver = CheckpointDriver([table], f"{mvfs}/run1", interval_steps=1)
+    driver.step()  # snapshot
+    table.add(vals)  # diverge live state from the snapshot
+    driver.close()
+
+    restored = driver.restore()
+    assert restored
+    np.testing.assert_allclose(table.get(), vals, rtol=1e-6)
+
+
+def test_fsspec_fallback_memory_scheme():
+    """Schemes fsspec knows (memory://, gs://, s3://…) engage without
+    explicit registration; memory:// is the offline-testable one."""
+    pytest.importorskip("fsspec")
+    with mv_io.get_stream("memory://ckpt/x.bin", "w") as s:
+        s.write(b"payload")
+    with mv_io.get_stream("memory://ckpt/x.bin", "r") as s:
+        assert s.read() == b"payload"
+
+
+def test_unknown_scheme_still_fatals():
+    with pytest.raises(mv.log.FatalError):
+        mv_io.get_stream("bogus9z://x/y", "r")
+
+
+def test_mvfs_down_server_yields_bad_stream():
+    """A down server gives good()==False (the LocalStream contract), not a
+    raw socket exception from get_stream."""
+    bad = mv_io.get_stream("mvfs://127.0.0.1:1/x.bin", "r")  # port 1: refused
+    assert not bad.good()
+
+
+def test_mvfs_concurrent_writers_same_path(mvfs):
+    """Two concurrent write handles on one path must not share a temp file;
+    the committed file is exactly one writer's payload."""
+    a = mv_io.get_stream(f"{mvfs}/clash.bin", "w")
+    b = mv_io.get_stream(f"{mvfs}/clash.bin", "w")
+    a.write(b"A" * 1000)
+    b.write(b"B" * 500)
+    a.close()
+    b.close()
+    with mv_io.get_stream(f"{mvfs}/clash.bin", "r") as s:
+        data = s.read()
+    assert data == b"B" * 500  # last close wins, uncorrupted
+
+
+def test_checkpoint_driver_through_fsspec_scheme(mv_env):
+    """fs_for falls back to fsspec like get_stream does, so the driver can
+    snapshot to cloud-style schemes (memory:// is the offline one)."""
+    pytest.importorskip("fsspec")
+    table = mv.create_table("array", 6, np.float32)
+    table.add(np.arange(6, dtype=np.float32))
+    driver = CheckpointDriver([table], "memory://ckpt_run", interval_steps=1)
+    driver.step()
+    table.add(np.ones(6, np.float32))
+    assert driver.restore()
+    np.testing.assert_allclose(table.get(), np.arange(6, dtype=np.float32))
+    driver.close()
+
+
+def test_checkpoint_timer_survives_store_outage(tmp_path, mv_env):
+    """The periodic timer must outlive a transient remote-store failure."""
+    import time
+
+    from multiverso_tpu.io.mvfs import MvfsServer as Srv
+    server = Srv(str(tmp_path / "x"))
+    ep = server.serve("127.0.0.1:0")
+    table = mv.create_table("array", 4, np.float32)
+    table.add(np.ones(4, np.float32))
+    driver = CheckpointDriver([table], f"mvfs://{ep}/run",
+                              interval_seconds=0.15)
+    time.sleep(0.4)  # at least one good snapshot
+    server.stop()
+    reset_connections()
+    time.sleep(0.4)  # snapshots fail; the thread must survive
+    assert driver._thread.is_alive(), "timer thread died on store outage"
+    driver.close()

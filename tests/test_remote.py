@@ -356,3 +356,64 @@ def test_remote_bsp_with_serverside_admin_reads():
     assert done.get("ok")
     np.testing.assert_allclose(table.get(), np.full(4, 3.0))
     mv.shutdown()
+
+
+def test_remote_bsp_client_crash_names_stalled_worker():
+    """VERDICT r2 weak #9: a crashed remote worker's halted clock used to
+    wedge all peers silently. Kill a client mid-round and observe the
+    watchdog naming the dead worker; an operator finish_train on its behalf
+    releases the survivors."""
+    import subprocess
+    import time
+
+    from multiverso_tpu.runtime.message import Message, MsgType
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    mv.init(sync=True, ps_role="server", remote_workers=2,
+            sync_stall_seconds=0.3)
+    table = mv.create_table("array", 4, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    server = Zoo.instance().server
+
+    child_script = os.path.join(os.path.dirname(__file__),
+                                "remote_crash_child.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(child_script)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    child = subprocess.Popen(
+        [sys.executable, child_script, endpoint, str(table.table_id)],
+        stdout=subprocess.PIPE, text=True, env=env)
+
+    survivor_done = {}
+
+    def survivor():
+        client = mv.remote_connect(endpoint)
+        rt = client.table(table.table_id)
+        for _ in range(2):  # round 1 completes with the child; round 2's
+            rt.add(np.ones(4, np.float32))  # get blocks on the dead worker
+            rt.get()
+        survivor_done["ok"] = True
+        client.close()
+
+    t = threading.Thread(target=survivor)
+    t.start()
+    # the child's round-1 get needs the survivor's round-1 add (BSP), so
+    # read its id only after the survivor is running
+    line = child.stdout.readline().strip()
+    assert line.startswith("round-1-done "), line
+    dead_wid = int(line.split()[1])
+    child.wait(timeout=60)
+    assert child.returncode == 9
+    deadline = time.monotonic() + 15
+    while server.last_stall is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    stall = server.last_stall
+    assert stall is not None, "watchdog never named the crashed worker"
+    assert f"worker(s) [{dead_wid}]" in stall, stall
+    # operator recovery: finish the dead worker's training on its behalf
+    server.send(Message(src=dead_wid, type=MsgType.Server_Finish_Train,
+                        table_id=table.table_id))
+    t.join(timeout=60)
+    assert not t.is_alive(), "survivor still wedged after finish_train"
+    assert survivor_done.get("ok")
+    mv.shutdown()
