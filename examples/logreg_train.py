@@ -2,7 +2,14 @@
 """Logistic regression, local and PS-mode (the reference's
 ``Applications/LogisticRegression`` driver shape).
 
-Run:  python examples/logreg_train.py
+Run:  python examples/logreg_train.py               # built-in demo
+      python examples/logreg_train.py train.conf    # key=value config file
+
+Config-file mode mirrors the reference binary (``logistic_regression
+config_file``): the file names input/output sizes, reader type
+(default/weight/bsparse), train/test files (';'-separated URIs — mvfs://
+works), objective, regularizer, PS knobs. See
+multiverso_tpu/models/lr_io.py for the field list.
 """
 
 import os
@@ -13,20 +20,65 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import multiverso_tpu as mv
-from multiverso_tpu.models.logreg import LogReg, LogRegConfig, PSLogReg
+from multiverso_tpu.models.logreg import LogReg, LogRegConfig, PSLogReg, make_model
+from multiverso_tpu.models.lr_io import Configure, make_reader
 
 
-def make_data(rng, w, n=2048, d=30):
-    X = rng.normal(size=(n, d)).astype(np.float32)
-    y = (X @ w > 0).astype(np.int32)
-    return X, y
+def run_from_config(path: str) -> None:
+    """The reference driver: everything from the config file
+    (Applications/LogisticRegression/src/logreg.cpp:40-88)."""
+    conf = Configure(path)
+    model_config = conf.model_config()
+    if conf.use_ps:
+        mv.init()
+    model = make_model(model_config)
+    if conf.init_model_file:
+        model.load_weights(np.load(conf.init_model_file))
+
+    reader = make_reader(conf.reader_type, conf.train_file,
+                         conf.minibatch_size, conf.input_size,
+                         sparse=conf.sparse, max_nnz=conf.max_nnz)
+    seen = 0
+    for batch in reader.epochs(conf.train_epoch):
+        loss = model.update(batch)
+        seen += len(batch["y"])
+        if conf.show_time_per_sample and seen % conf.show_time_per_sample < conf.minibatch_size:
+            print(f"samples {seen}: loss {loss:.4f}")
+    reader.close()
+    if isinstance(model, PSLogReg):
+        model.finish()
+
+    if conf.test_file:
+        test_reader = make_reader(conf.reader_type, conf.test_file,
+                                  conf.minibatch_size, conf.input_size,
+                                  sparse=conf.sparse, max_nnz=conf.max_nnz)
+        correct = total = 0
+        with open(conf.output_file, "w") as out:
+            for batch in test_reader.batches():
+                pred = model.predict(batch)
+                out.writelines(f"{p}\n" for p in pred)
+                correct += int((pred == batch["y"].reshape(-1)).sum())
+                total += len(pred)
+        test_reader.close()
+        print(f"test accuracy: {correct / max(total, 1):.3f} -> {conf.output_file}")
+
+    if conf.output_model_file:
+        np.save(conf.output_model_file, model.weights())
+        print(f"model -> {conf.output_model_file}.npy")
+    if conf.use_ps:
+        mv.shutdown()
 
 
-def main():
+def run_demo() -> None:
     rng = np.random.default_rng(0)
     true_w = rng.normal(size=30).astype(np.float32)
-    X, y = make_data(rng, true_w)
-    Xte, yte = make_data(rng, true_w, n=512)
+
+    def make_data(n=2048, d=30):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        return X, (X @ true_w > 0).astype(np.int32)
+
+    X, y = make_data()
+    Xte, yte = make_data(n=512)
 
     # local mode (reference `Model`)
     config = LogRegConfig(input_size=30, objective="sigmoid", lr=0.1,
@@ -51,4 +103,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1:
+        run_from_config(sys.argv[1])
+    else:
+        run_demo()

@@ -80,6 +80,8 @@ class MvfsServer:
         os.makedirs(self.root, exist_ok=True)
         self._sock: Optional[socket.socket] = None
         self._threads: list = []
+        self._live_conns: set = set()
+        self._conns_lock = threading.Lock()
         self._active = False
         self.endpoint = ""
 
@@ -99,13 +101,34 @@ class MvfsServer:
         return self.endpoint
 
     def stop(self) -> None:
+        """Take the export offline: stop accepting AND sever established
+        connections (a stopped server must not keep mutating the root
+        through old sockets)."""
         self._active = False
         if self._sock is not None:
+            try:
+                # shutdown BEFORE close: a thread blocked in accept() holds
+                # the open file description, keeping the port bound after
+                # close(); shutdown wakes it so the port actually frees
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+        with self._conns_lock:
+            live = list(self._live_conns)
+        for conn in live:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def __enter__(self) -> "MvfsServer":
         return self
@@ -136,6 +159,11 @@ class MvfsServer:
         # per-connection open handles: id -> (file object, temp path or None)
         handles: Dict[int, Tuple[Any, Optional[str]]] = {}
         next_id = 0
+        with self._conns_lock:
+            if not self._active:
+                conn.close()
+                return
+            self._live_conns.add(conn)
         try:
             while True:
                 try:
@@ -159,6 +187,8 @@ class MvfsServer:
                     pass
                 if tmp is not None and os.path.exists(tmp):
                     os.remove(tmp)  # uncommitted write: discard
+            with self._conns_lock:
+                self._live_conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -220,17 +250,24 @@ class MvfsRemoteError(IOError):
 
 
 class _MvfsConn:
-    """One client connection; serialized request/reply."""
+    """One client connection; serialized request/reply. A transport failure
+    evicts this connection from the pool so the next open redials (a
+    restarted server must not poison every later filesystem op)."""
 
     def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), timeout=30)
         self._lock = threading.Lock()
 
     def call(self, header: Dict[str, Any], payload: bytes = b""
              ) -> Tuple[Dict[str, Any], bytes]:
-        with self._lock:
-            _send(self._sock, header, payload)
-            reply, data = _recv(self._sock)
+        try:
+            with self._lock:
+                _send(self._sock, header, payload)
+                reply, data = _recv(self._sock)
+        except OSError:
+            _evict(self.host, self.port, self)
+            raise
         if "err" in reply:
             raise MvfsRemoteError(f"mvfs server: {reply['err']}")
         return reply, data
@@ -251,9 +288,18 @@ _conns_lock = threading.Lock()
 def _conn_for(host: str, port: int) -> _MvfsConn:
     with _conns_lock:
         conn = _conns.get((host, port))
-        if conn is None:
-            conn = _conns[(host, port)] = _MvfsConn(host, port)
+    if conn is not None:
         return conn
+    # dial OUTSIDE the global lock: a blackholed endpoint (30s connect
+    # timeout) must not stall mvfs traffic to healthy servers
+    fresh = _MvfsConn(host, port)
+    with _conns_lock:
+        conn = _conns.get((host, port))
+        if conn is not None:  # raced: keep the first, drop ours
+            fresh.close()
+            return conn
+        _conns[(host, port)] = fresh
+    return fresh
 
 
 def _evict(host: str, port: int, conn: _MvfsConn) -> None:
@@ -272,18 +318,27 @@ def reset_connections() -> None:
         _conns.clear()
 
 
+def _host_port(uri: URI) -> Tuple[str, int]:
+    """host:port from the authority; a missing/garbled port is a malformed
+    address (programmer error), reported as such — not a bad stream."""
+    host, sep, port = uri.host.rpartition(":")
+    if not sep or not port.isdigit():
+        log.fatal("mvfs address needs host:port, got %r", uri.raw)
+    return host, int(port)
+
+
 class MvfsStream(Stream):
     """Client-side stream on a served path (``mvfs://host:port/path``)."""
 
     def __init__(self, uri: URI, mode: str) -> None:
-        host, _, port = uri.host.rpartition(":")
+        host, port = _host_port(uri)
         self._conn: Optional[_MvfsConn] = None
         self._writing = "w" in mode or "a" in mode
         op = ("open_w" if self._writing else "open_r")
         try:
             # connect inside the guard: a down server yields a bad stream
             # (good() False), matching the LocalStream/FsspecStream contract
-            self._conn = _conn_for(host, int(port))
+            self._conn = _conn_for(host, port)
             reply, _ = self._conn.call(
                 {"op": op, "path": uri.path, "append": "a" in mode})
             self._handle: Optional[int] = reply["handle"]
@@ -293,7 +348,7 @@ class MvfsStream(Stream):
         except OSError as exc:  # transport failure: evict the pooled conn
             log.error("MvfsStream: cannot reach %s (%s)", uri.raw, exc)
             if self._conn is not None:
-                _evict(host, int(port), self._conn)
+                _evict(host, port, self._conn)
                 self._conn = None
             self._handle = None
 
@@ -326,8 +381,8 @@ class MvfsFileSystem(FileSystem):
 
     def _split(self, address: str) -> Tuple[_MvfsConn, str]:
         uri = URI.parse(address)
-        host, _, port = uri.host.rpartition(":")
-        return _conn_for(host, int(port)), uri.path
+        host, port = _host_port(uri)
+        return _conn_for(host, port), uri.path
 
     def exists(self, address: str) -> bool:
         conn, path = self._split(address)

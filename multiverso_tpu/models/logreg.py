@@ -44,6 +44,13 @@ class LogRegConfig:
     use_ps: bool = False
     sync_frequency: int = 1
     pipeline: bool = False
+    # app updater (reference configure.h:91 "[default] [sgd] [ftrl]"):
+    # "default" subtracts the RAW gradient (updater.cpp:12-37, Process is a
+    # no-op — lr unused); "sgd" scales by a decayed lr:
+    # max(1e-3, lr - updates/(lr_coef*minibatch)) (sgd_updater Process);
+    # "ftrl" = the optimizer lives in the FTRL table (objective "ftrl").
+    updater_type: str = "sgd"
+    lr_coef: float = 1e6
     # FTRL hyperparameters
     alpha: float = 0.1
     beta: float = 1.0
@@ -114,6 +121,25 @@ def _grad_and_loss(config: LogRegConfig):
     return gl_sparse
 
 
+def _check_updater_type(config: LogRegConfig) -> None:
+    if config.updater_type not in ("default", "sgd", "ftrl"):
+        log.fatal("updater_type %r not in default|sgd|ftrl",
+                  config.updater_type)
+    if config.updater_type == "ftrl" and config.objective != "ftrl":
+        log.fatal("updater_type=ftrl requires objective=ftrl (the FTRL "
+                  "optimizer lives in the table)")
+
+
+def _effective_lr(config: LogRegConfig, updates: int,
+                  override: Optional[float]) -> float:
+    """Reference SGDUpdater::Process decay; 'default' subtracts raw."""
+    if override is not None:
+        return override
+    if config.updater_type == "default":
+        return 1.0
+    return max(1e-3, config.lr - updates / (config.lr_coef * config.minibatch))
+
+
 def _regularizer_grad(config: LogRegConfig):
     if config.regular == "l2":
         return lambda w: config.regular_coef * w
@@ -129,7 +155,9 @@ class LogReg:
     def __init__(self, config: LogRegConfig) -> None:
         if config.objective == "ftrl" and not config.use_ps:
             log.fatal("ftrl objective runs through the FTRL table (use_ps=True)")
+        _check_updater_type(config)
         self.config = config
+        self._updates = 0
         rng = np.random.default_rng(config.seed)
         self.w = jnp.asarray(
             rng.normal(0, 0.01, (config.output_size, config.input_size + 1))
@@ -163,9 +191,15 @@ class LogReg:
                lr: Optional[float] = None) -> float:
         with monitor("LOGREG_UPDATE"):
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
-            self.w, loss = self._train(self.w, batch,
-                                       self.config.lr if lr is None else lr)
+            self.w, loss = self._train(
+                self.w, batch, _effective_lr(self.config, self._updates, lr))
+            self._updates += 1
             return float(loss)
+
+    def load_weights(self, w: np.ndarray) -> None:
+        """Warm start (reference: init_model_file, ps_model.cpp:116-154)."""
+        self.w = jnp.asarray(np.asarray(w, np.float32).reshape(
+            self.config.output_size, self.config.input_size + 1))
 
     def predict(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
@@ -190,7 +224,9 @@ class PSLogReg(LogReg):
 
     def __init__(self, config: LogRegConfig) -> None:
         import multiverso_tpu as mv
+        _check_updater_type(config)
         self.config = config
+        self._updates = 0
         self._n = config.output_size * (config.input_size + 1)
         self._bias_key = config.input_size
         gl = _grad_and_loss(config)
@@ -247,7 +283,8 @@ class PSLogReg(LogReg):
 
     def update(self, batch: Dict[str, np.ndarray],
                lr: Optional[float] = None) -> float:
-        lr = self.config.lr if lr is None else lr
+        lr = _effective_lr(self.config, self._updates, lr)
+        self._updates += 1
         idx_np = np.asarray(batch["idx"]) if self.config.sparse else None
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         grad, loss = self._gl(self.w, batch)
@@ -302,6 +339,25 @@ class PSLogReg(LogReg):
         if self._pending_get is not None:
             self.table.wait(self._pending_get)
             self._pending_get = None
+        self.w = jnp.asarray(self._pull())
+
+    def load_weights(self, w: np.ndarray) -> None:
+        """Warm start THROUGH the table so every worker sees it (reference
+        PSModel::Load pushed the loaded model as a delta the same way,
+        ps_model.cpp:116-154). Not available for FTRL tables: their z/n
+        state cannot be reconstructed from dense weights."""
+        if self.config.objective == "ftrl":
+            log.fatal("init model into an FTRL table is unsupported "
+                      "(optimizer state is not derivable from weights)")
+        o, cols = self.config.output_size, self.config.input_size + 1
+        w = np.asarray(w, np.float32).reshape(o, cols)
+        current = self._pull()
+        delta = current - w  # sgd-family server tables apply data -= delta
+        if self.config.sparse:
+            keys = np.arange(cols, dtype=np.int64)
+            self.table.add(keys, delta.T)
+        else:
+            self.table.add(delta.reshape(-1))
         self.w = jnp.asarray(self._pull())
 
 

@@ -112,6 +112,7 @@ class AsyncBuffer(Generic[T]):
         self._current = 0
         self._ready = Waiter(1)
         self._queue: MtQueue[int] = MtQueue()
+        self._error: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         self._queue.push(self._current)
@@ -121,11 +122,18 @@ class AsyncBuffer(Generic[T]):
             idx = self._queue.pop()
             if idx is None:
                 return
-            self._fill(self._buffers[idx])
+            try:
+                self._fill(self._buffers[idx])
+            except BaseException as exc:  # surface in get(), don't die silent
+                self._error = exc
+                self._ready.notify()
+                return
             self._ready.notify()
 
     def get(self) -> T:
         self._ready.wait()
+        if self._error is not None:
+            raise RuntimeError("AsyncBuffer fill failed") from self._error
         filled = self._current
         self._current = 1 - self._current
         self._ready.reset(1)

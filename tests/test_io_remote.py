@@ -3,6 +3,8 @@
 fsspec fallback for cloud schemes. Proves the Stream factory is a real
 dispatch seam and that CheckpointDriver snapshots THROUGH a remote scheme."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -184,3 +186,49 @@ def test_checkpoint_timer_survives_store_outage(tmp_path, mv_env):
     time.sleep(0.4)  # snapshots fail; the thread must survive
     assert driver._thread.is_alive(), "timer thread died on store outage"
     driver.close()
+
+
+def test_mvfs_missing_port_is_fatal():
+    with pytest.raises(mv.log.FatalError):
+        mv_io.get_stream("mvfs://hostonly/x.bin", "r")
+
+
+def test_mvfs_stop_severs_live_connections(tmp_path):
+    """stop() must take established connections down too — a 'stopped'
+    server must not keep serving writes into its root."""
+    server = MvfsServer(str(tmp_path / "r"))
+    ep = server.serve("127.0.0.1:0")
+    with mv_io.get_stream(f"mvfs://{ep}/a.bin", "w") as s:
+        s.write(b"x")  # establishes the pooled connection
+    server.stop()
+    with pytest.raises((IOError, OSError)):
+        fs = mv_io.fs_for(f"mvfs://{ep}")
+        fs.exists(f"mvfs://{ep}/a.bin")
+    reset_connections()
+
+
+def test_mvfs_pool_recovers_after_server_restart(tmp_path):
+    """Filesystem ops evict broken pooled connections, so a restarted
+    server is reachable without manual reset_connections()."""
+    server = MvfsServer(str(tmp_path / "r"))
+    ep = server.serve("127.0.0.1:0")
+    fs = mv_io.fs_for(f"mvfs://{ep}")
+    with mv_io.get_stream(f"mvfs://{ep}/a.bin", "w") as s:
+        s.write(b"x")
+    assert fs.exists(f"mvfs://{ep}/a.bin")
+    server.stop()
+    with pytest.raises((IOError, OSError)):
+        fs.exists(f"mvfs://{ep}/a.bin")  # fails AND evicts the dead conn
+    server2 = MvfsServer(str(tmp_path / "r"))
+    deadline = time.monotonic() + 10
+    while True:  # old conn may sit in FIN_WAIT briefly; rebind when clear
+        try:
+            server2.serve(ep)  # same port
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+    assert fs.exists(f"mvfs://{ep}/a.bin")  # redialed automatically
+    reset_connections()
+    server2.stop()
