@@ -1,0 +1,33 @@
+# CI-shape runner — the Docker-suite analog (the reference image built the
+# lib, ran nosetests + lua tests + mpirun end-to-end targets,
+# deploy/docker/Dockerfile:93-113). One command reproduces everything the
+# driver measures:
+#
+#   make check          native build + tests + multi-chip dryrun + bench
+#   make native         just the C++ layer (libmultiverso_tpu.so + C client)
+#   make test           just the suite (8-device virtual CPU mesh)
+#   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
+#   make bench          the headline JSON line (real TPU when available)
+
+PYTHON ?= python
+CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: check native test dryrun bench clean
+
+check: native test dryrun bench
+
+native:
+	$(MAKE) -C multiverso_tpu/native
+	$(MAKE) -C multiverso_tpu/native test_c_api CC=gcc
+
+test: native
+	$(PYTHON) -m pytest tests/ -x -q
+
+dryrun:
+	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	$(MAKE) -C multiverso_tpu/native clean

@@ -122,6 +122,11 @@ def _grad_and_loss(config: LogRegConfig):
 
 
 def _check_updater_type(config: LogRegConfig) -> None:
+    if config.objective not in ("sigmoid", "softmax", "ftrl"):
+        log.fatal("objective %r not in sigmoid|softmax|ftrl",
+                  config.objective)
+    if config.regular not in ("none", "l1", "l2"):
+        log.fatal("regular %r not in none|l1|l2", config.regular)
     if config.updater_type not in ("default", "sgd", "ftrl"):
         log.fatal("updater_type %r not in default|sgd|ftrl",
                   config.updater_type)
@@ -132,12 +137,15 @@ def _check_updater_type(config: LogRegConfig) -> None:
 
 def _effective_lr(config: LogRegConfig, updates: int,
                   override: Optional[float]) -> float:
-    """Reference SGDUpdater::Process decay; 'default' subtracts raw."""
+    """Reference SGDUpdater::Process decay; 'default' subtracts raw. The
+    1e-3 decay floor never RAISES the rate above the configured lr (a
+    config with lr < 1e-3 trains at exactly that lr, undecayed)."""
     if override is not None:
         return override
     if config.updater_type == "default":
         return 1.0
-    return max(1e-3, config.lr - updates / (config.lr_coef * config.minibatch))
+    floor = min(1e-3, config.lr)
+    return max(floor, config.lr - updates / (config.lr_coef * config.minibatch))
 
 
 def _regularizer_grad(config: LogRegConfig):

@@ -301,6 +301,9 @@ class BSparseSampleReader(SampleReader):
             log.fatal("BSparseSampleReader requires sparse data")
         self._stream: Optional[mv_io.Stream] = None
         self._pending = b""
+        self._cursor = 0  # consumed prefix of _pending (compact on refill,
+        # not per record — slicing per ~20B record would memcpy the whole
+        # window each time)
         super().__init__(files, minibatch, input_size, sparse=True,
                          max_nnz=max_nnz)
 
@@ -311,6 +314,7 @@ class BSparseSampleReader(SampleReader):
         if first:
             self._file_idx = 0
             self._pending = b""
+            self._cursor = 0
         if self._file_idx < len(self.files):
             self._stream = mv_io.get_stream(self.files[self._file_idx], "r")
             self._file_idx += 1
@@ -319,23 +323,29 @@ class BSparseSampleReader(SampleReader):
 
     def _next_record(self):
         while not self._eof:
-            if len(self._pending) >= _BS_HEAD.size:
-                count, label, weight = _BS_HEAD.unpack_from(self._pending)
+            avail = len(self._pending) - self._cursor
+            if avail >= _BS_HEAD.size:
+                count, label, weight = _BS_HEAD.unpack_from(
+                    self._pending, self._cursor)
                 need = _BS_HEAD.size + 8 * count
-                if len(self._pending) >= need:
-                    keys = np.frombuffer(self._pending, np.uint64,
-                                         count, _BS_HEAD.size)
-                    self._pending = self._pending[need:]
-                    return label, keys, weight
+                if avail >= need:
+                    keys = np.frombuffer(self._pending, np.uint64, count,
+                                         self._cursor + _BS_HEAD.size)
+                    self._cursor += need
+                    return label, keys.copy(), weight
             chunk = self._stream.read(1 << 16) if self._stream else b""
             if not chunk:
-                if self._pending:
+                if len(self._pending) - self._cursor:
                     log.fatal("bsparse: %d trailing bytes in %s",
-                              len(self._pending),
+                              len(self._pending) - self._cursor,
                               self.files[self._file_idx - 1])
+                self._pending = b""
+                self._cursor = 0
                 self._open_next_file()
             else:
-                self._pending += chunk
+                # compact the consumed prefix only on refill (amortized)
+                self._pending = self._pending[self._cursor:] + chunk
+                self._cursor = 0
         return None
 
     def _fill(self, buf: Dict[str, np.ndarray]) -> None:

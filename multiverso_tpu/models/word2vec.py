@@ -171,6 +171,12 @@ def _sgns_core(w_in, w_out, in_ids, in_weights, out_ids, labels, mask, lr,
     gin = grad_rows.reshape(-1, dim)
     gout = grad_u.reshape(-1, dim)
     if combine == "mean":
+        # count-divide + XLA scatter-add, deliberately NOT a fused
+        # sort→segment-mean→unique-row scatter: that variant was built and
+        # measured (r2) at 10.8 vs 6.3 ms/block on v5e for this workload —
+        # the in-jit argsort over ~123k ids costs more than duplicate
+        # pre-combining saves; it would only pay off under extreme
+        # duplication or when a stateful updater needs unique rows.
         in_count = jnp.zeros(w_in.shape[0], v.dtype).at[flat_in].add(1.0)
         out_count = jnp.zeros(w_out.shape[0], v.dtype).at[flat_out].add(1.0)
         gin = gin / in_count[flat_in][:, None]

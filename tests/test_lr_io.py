@@ -271,3 +271,23 @@ def test_reader_surfaces_parse_errors(tmp_path):
         for _ in reader.batches():
             pass
     reader.close()
+
+
+def test_bad_objective_and_regular_values_fatal(tmp_path):
+    """Unknown VALUES must fail as loudly as unknown keys — a typo like
+    regular_type=L3 must not silently disable regularization."""
+    from multiverso_tpu.models.logreg import LogRegConfig
+    f = _write(tmp_path / "typo.conf",
+               "input_size=4\nobjective_type=sofmax\n")
+    with pytest.raises(mv.log.FatalError):
+        LogReg(Configure(f).model_config())
+    with pytest.raises(mv.log.FatalError):
+        LogReg(LogRegConfig(input_size=4, regular="l3"))
+
+
+def test_small_lr_not_raised_by_decay_floor():
+    """A configured lr below 1e-3 must train at that lr, not be silently
+    raised to the decay floor."""
+    from multiverso_tpu.models.logreg import LogRegConfig, _effective_lr
+    config = LogRegConfig(input_size=2, lr=5e-4)
+    assert _effective_lr(config, 0, None) == 5e-4
