@@ -102,10 +102,14 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
     delta pushes through the updater (the reference's only benchmarked
     configuration: WordEmbedding skip-gram on PS tables).
 
-    Timing is wall-clock over train_block calls, which is honest here by
-    construction: every block ends in host-side numpy deltas computed from
-    fetched rows — a dependent fetch per block — so async dispatch cannot
-    underreport. Slope over block counts removes compile time.
+    Timing is wall-clock over the PIPELINED submit/finish loop (the
+    reference's benchmarked configuration ran its block pipeline,
+    distributed_wordembedding.cpp:202-223), which is honest by
+    construction: finish_block performs a dependent device→host stats
+    fetch for every submitted block (at most one block in flight), so
+    async dispatch cannot underreport. Compile time is excluded by warming
+    every block (all trace buckets) before timing; the figure is the
+    best-of-3 average over 16 steady-state blocks.
     """
     import multiverso_tpu as mv
     from multiverso_tpu.models.vocab import Dictionary
@@ -128,23 +132,29 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
     mv.init([])
     try:
         trainer = PSTrainer(config, d)
-        for b in blocks[:2]:  # compile + warm the pow2 trace buckets
+        for b in blocks:  # compile + warm every block's pow2 trace buckets
             trainer.train_block(b)
 
         def run(k):
             best = float("inf")
-            for _ in range(2):
+            for _ in range(3):
                 t0 = time.perf_counter()
+                pend = None
                 for i in range(k):
-                    trainer.train_block(blocks[i % n_blocks])
+                    nxt = trainer.submit_block(blocks[i % n_blocks])
+                    if pend is not None:
+                        trainer.finish_block(pend)
+                    pend = nxt
+                if pend is not None:
+                    trainer.finish_block(pend)
                 best = min(best, time.perf_counter() - t0)
             return best
-        k1, k2 = 2, 6
-        t1 = run(k1)
-        t2 = run(k2)
-        per_block = (t2 - t1) / (k2 - k1)
-        if per_block <= 0:
-            per_block = t2 / k2
+        # every trace bucket is warmed above, so there is no per-run fixed
+        # cost to subtract: best-of-3 average over 16 blocks is the honest
+        # steady-state figure (a 2-point slope doubles the tunnel's
+        # run-to-run latency noise instead of removing anything)
+        k2 = 16
+        per_block = run(k2) / k2
         stats = trainer.last_block_stats
         return {
             "ps_words_per_sec": round(block_tokens / per_block, 1),

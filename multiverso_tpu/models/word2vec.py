@@ -544,6 +544,10 @@ def make_candidate_train_step(config: Word2VecConfig):
     keeps per-occurrence SGD semantics sequential ACROSS minibatches (like
     the reference's hot loop) while each minibatch is one MXU einsum set.
     """
+    return jax.jit(_candidate_step_fn(config), donate_argnums=(0, 1))
+
+
+def _candidate_step_fn(config: Word2VecConfig):
     combine = config.grad_combine
     cap = config.max_row_step
 
@@ -559,7 +563,39 @@ def make_candidate_train_step(config: Word2VecConfig):
             body, (w_in_c, w_out_c), batches)
         return w_in_c, w_out_c, losses.sum(), weights.sum()
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return step
+
+
+def make_candidate_delta_step(config: Word2VecConfig):
+    """Device-path variant: consumes the HBM-resident gather buckets
+    (bucket, padded_cols) directly and returns the PUSH PAYLOAD
+    (delta · scale) instead of new weights. Everything host-expensive moves
+    into the one dispatch: the col slice, the token→compact-slot remap
+    (``searchsorted`` over the padded candidate ids — the same arrays the
+    push needs anyway), the uint8→f32 label/mask casts (labels and mask
+    cross the host boundary as bytes, quartering that transfer), the
+    training scan, and the delta. Nothing aliases the caller's buffers
+    after donation."""
+    step = _candidate_step_fn(config)
+    dim = config.dim
+    # note: an on-device searchsorted remap was tried here (shipping raw
+    # token ids) and LOST — 13.7k vs 27.9k words/s on the bench chip; the
+    # binary search over a 131k-id bucket costs far more on the VPU than
+    # the ~19ms numpy remap it replaced. The remap stays host-side.
+
+    def dstep(cached_in, cached_out, batches, lr, scale):
+        w_in = cached_in[:, :dim]
+        w_out = cached_out[:, :dim]
+        remapped = dict(batches,
+                        labels=batches["labels"].astype(w_in.dtype),
+                        mask=batches["mask"].astype(w_in.dtype))
+        new_in, new_out, loss_sum, w_sum = step(w_in, w_out, remapped, lr)
+        # one (2,) stats array: the caller fetches loss/weight in a SINGLE
+        # device→host round trip (a scalar fetch costs a full tunnel RTT)
+        return ((new_in - w_in) * scale, (new_out - w_out) * scale,
+                jnp.stack([loss_sum, w_sum]))
+
+    return jax.jit(dstep, donate_argnums=(0, 1))
 
 
 class PSTrainer:
@@ -609,6 +645,7 @@ class PSTrainer:
             self.huffman = None
             self._neg_draw = host_negative_sampler(dictionary.counts)
         self.step_fn = make_candidate_train_step(config)
+        self.delta_step_fn = make_candidate_delta_step(config)
         self.keep = dictionary.keep_probs(config.sample)
         self.rng = np.random.default_rng(config.seed)
         self.words_trained = 0
@@ -648,46 +685,63 @@ class PSTrainer:
 
     def train_block(self, block: np.ndarray,
                     lr: Optional[float] = None) -> float:
+        pend = self.submit_block(block, lr)
+        return self.finish_block(pend)
+
+    def submit_block(self, block: np.ndarray,
+                     lr: Optional[float] = None) -> Optional[Dict]:
+        """Issue a block's pulls, training dispatch, and pushes WITHOUT
+        waiting: the reference's pipeline mode overlapped exactly this —
+        one thread prefetched the next block's rows while others trained
+        (distributed_wordembedding.cpp:202-223). Returns a pending record
+        for ``finish_block``; None when the block degenerates."""
         block = subsample_block(block, self.keep, self.rng)
         if len(block) < 2:
-            return 0.0
+            return None
         lr = self.config.lr if lr is None else lr
         in_tok, in_w, predict = self._block_pairs(block)
         if len(predict) == 0:
-            return 0.0
+            return None
         out_tok, labels, mask = self._block_outputs(predict)
 
         # candidate sets: exactly the rows this block trains; both pulls are
         # issued before either is awaited so their round trips overlap (the
-        # remote path pays one RTT, not two)
+        # remote path pays one RTT, not two). In-process workers use the
+        # DEVICE path: candidate rows are gathered in HBM and stay there —
+        # the LocalForward analog; remote clients fall back to host arrays.
         in_cand = np.unique(in_tok[in_tok >= 0]).astype(np.int32)
         out_cand = np.unique(out_tok[out_tok >= 0]).astype(np.int32)
-        h_in = self.input_table.get_async(in_cand)
-        h_out = self.output_table.get_async(out_cand)
-        cached_in = self.input_table.wait_get(h_in, in_cand)
-        cached_out = self.output_table.wait_get(h_out, out_cand)
-
-        # compact matrices: pow2 row buckets with a sentinel scratch row so
-        # jit traces are reused across blocks of different candidate counts
+        device_io = (getattr(self.input_table, "supports_device_io", False)
+                     and getattr(self.output_table, "supports_device_io",
+                                 False))
         dim = self.config.dim
         n_in, n_out = len(in_cand), len(out_cand)
-        r_in = max(_next_pow2(n_in + 1), 8)
-        r_out = max(_next_pow2(n_out + 1), 8)
-        w_in_c = np.zeros((r_in, dim), np.float32)
-        w_in_c[:n_in] = cached_in
-        w_out_c = np.zeros((r_out, dim), np.float32)
-        w_out_c[:n_out] = cached_out
+        if device_io:
+            h_in = self.input_table.get_device_async(in_cand)
+            h_out = self.output_table.get_device_async(out_cand)
+            cached_in = self.input_table.wait_device(h_in, in_cand)
+            cached_out = self.output_table.wait_device(h_out, out_cand)
+            # the gather bucket IS the compact space: slots >= n are
+            # sentinel copies (guaranteed >= 1 by the server's ensure_pad)
+            r_in, r_out = cached_in.shape[0], cached_out.shape[0]
+            sent_in, sent_out = n_in, n_out  # first pad slot
+        else:
+            h_in = self.input_table.get_async(in_cand)
+            h_out = self.output_table.get_async(out_cand)
+            cached_in = self.input_table.wait_get(h_in, in_cand)
+            cached_out = self.output_table.wait_get(h_out, out_cand)
+            # compact matrices: pow2 row buckets + a sentinel scratch row so
+            # jit traces are reused across blocks of different candidate counts
+            r_in = max(_next_pow2(n_in + 1), 8)
+            r_out = max(_next_pow2(n_out + 1), 8)
+            w_in_c = np.zeros((r_in, dim), np.float32)
+            w_in_c[:n_in] = cached_in
+            w_out_c = np.zeros((r_out, dim), np.float32)
+            w_out_c[:n_out] = cached_out
+            sent_in, sent_out = r_in - 1, r_out - 1
 
-        # remap token ids → compact slots (sentinel = last row)
-        in_ids = np.where(in_tok >= 0,
-                          np.searchsorted(in_cand, np.maximum(in_tok, 0)),
-                          r_in - 1).astype(np.int32)
-        out_ids = np.where(out_tok >= 0,
-                           np.searchsorted(out_cand, np.maximum(out_tok, 0)),
-                           r_out - 1).astype(np.int32)
-
-        # stack minibatches: pad pairs to a full (N, B, ...) block aimed at
-        # the sentinels, N bucketed to pow2 for trace reuse
+        # stack minibatches: pad pairs to a full (N, B, ...) block, N
+        # bucketed to pow2 for trace reuse
         bp = self.config.batch_pairs
         p = len(predict)
         n = _next_pow2(-(-p // bp))
@@ -695,47 +749,128 @@ class PSTrainer:
             flat = np.full((n * bp,) + arr.shape[1:], fill, arr.dtype)
             flat[:p] = arr
             return flat.reshape((n, bp) + arr.shape[1:])
-        batches = {
-            "in_ids": pad(in_ids, r_in - 1),
-            "in_weights": pad(in_w, 0.0),
-            "out_ids": pad(out_ids, r_out - 1),
-            "labels": pad(labels, 0.0),
-            "mask": pad(mask, 0.0),
-        }
-        new_in, new_out, loss_sum, w_sum = self.step_fn(
-            jnp.asarray(w_in_c), jnp.asarray(w_out_c),
-            {k: jnp.asarray(v) for k, v in batches.items()}, lr)
-        new_in = np.asarray(new_in[:n_in])
-        new_out = np.asarray(new_out[:n_out])
 
-        delta_in = new_in - cached_in
-        delta_out = new_out - cached_out
-        if self.use_adagrad:
-            # server owns the optimizer: ship the block's summed raw gradient
-            # G ≈ -(delta)/lr; the adagrad updater applies
-            # data -= lr·G/sqrt(g_sqr+rho) with HBM-resident accumulators
-            from multiverso_tpu.updaters import AddOption
-            opt = AddOption(worker_id=self.input_table._channel.worker_id(),
-                            learning_rate=lr)
-            a1 = self.input_table.add_async(-delta_in / lr, row_ids=in_cand,
-                                            option=opt)
-            a2 = self.output_table.add_async(-delta_out / lr,
-                                             row_ids=out_cand, option=opt)
+        # token id → compact slot remap (host: measured faster than an
+        # on-device searchsorted, see make_candidate_delta_step)
+        in_ids = np.where(
+            in_tok >= 0,
+            np.searchsorted(in_cand, np.maximum(in_tok, 0)),
+            sent_in).astype(np.int32)
+        out_ids = np.where(
+            out_tok >= 0,
+            np.searchsorted(out_cand, np.maximum(out_tok, 0)),
+            sent_out).astype(np.int32)
+        batches_d = {
+            "in_ids": jnp.asarray(pad(in_ids, sent_in)),
+            "in_weights": jnp.asarray(pad(in_w, 0.0)),
+            "out_ids": jnp.asarray(pad(out_ids, sent_out)),
+        }
+
+        if device_io:
+            # ONE dispatch: col slice + training scan + delta·scale;
+            # deltas never leave HBM and labels/mask cross as uint8.
+            # Full-bucket push with sentinel-aimed pad ids (pad deltas are
+            # exactly zero — masked grads carry zero weight), so shapes
+            # stay static per pow2 bucket.
+            batches_d["labels"] = jnp.asarray(pad(labels.astype(np.uint8), 0))
+            batches_d["mask"] = jnp.asarray(pad(mask.astype(np.uint8), 0))
+            sentinel = self.input_table.sentinel_row
+            ids_in_p = np.concatenate(
+                [in_cand, np.full(r_in - n_in, sentinel, np.int32)])
+            sentinel_o = self.output_table.sentinel_row
+            ids_out_p = np.concatenate(
+                [out_cand, np.full(r_out - n_out, sentinel_o, np.int32)])
+            scale = (-1.0 / lr) if self.use_adagrad else 1.0
+            delta_in, delta_out, stats = self.delta_step_fn(
+                cached_in, cached_out, batches_d, lr, scale)
+            if self.use_adagrad:
+                from multiverso_tpu.updaters import AddOption
+                opt = AddOption(
+                    worker_id=self.input_table._channel.worker_id(),
+                    learning_rate=lr)
+                a1 = self.input_table.add_device_async(delta_in, ids_in_p,
+                                                       option=opt)
+                a2 = self.output_table.add_device_async(delta_out, ids_out_p,
+                                                        option=opt)
+            else:
+                a1 = self.input_table.add_device_async(delta_in, ids_in_p)
+                a2 = self.output_table.add_device_async(delta_out, ids_out_p)
         else:
-            a1 = self.input_table.add_async(delta_in, row_ids=in_cand)
-            a2 = self.output_table.add_async(delta_out, row_ids=out_cand)
+            # host path (remote proxies)
+            batches_d["labels"] = jnp.asarray(pad(labels, 0.0))
+            batches_d["mask"] = jnp.asarray(pad(mask, 0.0))
+            new_in, new_out, loss_sum, w_sum = self.step_fn(
+                jnp.asarray(w_in_c), jnp.asarray(w_out_c), batches_d, lr)
+            new_in = np.asarray(new_in[:n_in])
+            new_out = np.asarray(new_out[:n_out])
+            delta_in = new_in - cached_in
+            delta_out = new_out - cached_out
+            if self.use_adagrad:
+                # server owns the optimizer: ship the block's summed raw
+                # gradient G ≈ -(delta)/lr; the adagrad updater applies
+                # data -= lr·G/sqrt(g_sqr+rho) with HBM-resident accumulators
+                from multiverso_tpu.updaters import AddOption
+                opt = AddOption(
+                    worker_id=self.input_table._channel.worker_id(),
+                    learning_rate=lr)
+                a1 = self.input_table.add_async(-delta_in / lr,
+                                                row_ids=in_cand, option=opt)
+                a2 = self.output_table.add_async(-delta_out / lr,
+                                                 row_ids=out_cand, option=opt)
+            else:
+                a1 = self.input_table.add_async(delta_in, row_ids=in_cand)
+                a2 = self.output_table.add_async(delta_out, row_ids=out_cand)
+        if device_io:
+            stats.copy_to_host_async()  # overlap the RTT with later work
+        return {"a1": a1, "a2": a2, "stats": stats if device_io else None,
+                "loss_sum": None if device_io else loss_sum,
+                "w_sum": None if device_io else w_sum,
+                "n_in": n_in, "n_out": n_out, "pairs": p,
+                "block_len": int(len(block))}
+
+    def finish_block(self, pend: Optional[Dict]) -> float:
+        if pend is None:
+            return 0.0
         # overlapped pushes; waits reclaim the completions
-        self.input_table.wait(a1)
-        self.output_table.wait(a2)
-        self.count_table.add([0], [int(len(block))])
-        self.words_trained += len(block)
-        self.last_block_stats = {"in_rows": n_in, "out_rows": n_out,
-                                 "pairs": p}
+        self.input_table.wait(pend["a1"])
+        self.output_table.wait(pend["a2"])
+        if pend["stats"] is not None:
+            loss_sum, w_sum = np.asarray(pend["stats"])
+        else:
+            loss_sum, w_sum = pend["loss_sum"], pend["w_sum"]
+        self.count_table.add([0], [pend["block_len"]])
+        self.words_trained += pend["block_len"]
+        self.last_block_stats = {"in_rows": pend["n_in"],
+                                 "out_rows": pend["n_out"],
+                                 "pairs": pend["pairs"]}
         return float(loss_sum) / max(float(w_sum), 1.0)
 
     def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
               log_every_s: float = 10.0) -> None:
-        _train_loop(self, blocks, epochs, log_every_s, "PS ")
+        """Pipelined epoch loop: block i+1's host shaping + candidate pulls
+        + dispatch are issued BEFORE block i's completions are awaited —
+        the reference's pipeline mode (one thread prefetched the next
+        block's rows while others trained,
+        distributed_wordembedding.cpp:202-223), realized here as
+        submit-ahead over the async table API instead of extra threads."""
+        t0 = time.time()
+        last = t0
+        blocks = list(blocks)
+        pending = None
+        for _ in range(epochs):
+            for block in blocks:
+                nxt = self.submit_block(block)
+                if pending is not None:
+                    self.finish_block(pending)
+                pending = nxt
+                now = time.time()
+                if now - last > log_every_s:
+                    rate = self.words_trained / (now - t0)
+                    log.info("PS Words/sec: %.0fk  (trained %d)",
+                             rate / 1e3, self.words_trained)
+                    last = now
+        if pending is not None:
+            self.finish_block(pending)
 
     def embeddings(self) -> np.ndarray:
         return self.input_table.get()

@@ -360,7 +360,19 @@ class _RemoteArrayWorker(ArrayWorker):
 
 class _RemoteMatrixWorker(MatrixWorker):
     """MatrixWorker shaping (row buckets, sparse cache, option defaults)
-    over the wire."""
+    over the wire. Device IO is in-process only (the whole point is
+    skipping the host hop; a remote hop IS a host hop) — callers branch on
+    ``supports_device_io``."""
+
+    supports_device_io = False
+
+    def get_device_async(self, row_ids, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "get/get_async (host arrays)")
+
+    def add_device_async(self, values, row_ids, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "add/add_async (host arrays)")
 
     def __init__(self, spec, table_id: int, channel: RemoteChannel) -> None:
         WorkerTable.__init__(self, channel=channel)

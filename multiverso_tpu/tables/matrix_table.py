@@ -41,6 +41,16 @@ from multiverso_tpu.updaters import AddOption, GetOption, SGDUpdater, Updater, g
 from multiverso_tpu.utils import next_pow2 as _next_pow2
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("bucket", "cols"))
+def _device_pad(values: jax.Array, bucket: int, cols: int) -> jax.Array:
+    """(n, c) → (bucket, cols) zero-padded, entirely on device."""
+    out = jnp.zeros((bucket, cols), values.dtype)
+    return out.at[: values.shape[0], : values.shape[1]].set(values)
+
+
 def _use_pallas_scatter(backend: str, num_shards: int) -> bool:
     """Pallas row-DMA scatter serves single-shard TPU tables only:
     pallas_call has no SPMD partitioning rule, so multi-device tables take
@@ -145,14 +155,18 @@ class MatrixServer(ServerTable):
         return jax.jit(f, donate_argnums=(0, 1))
 
     # -- helpers -----------------------------------------------------------
-    def _bucket_ids(self, ids: np.ndarray,
-                    values: Optional[np.ndarray]) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], int]:
+    def _bucket_ids(self, ids: np.ndarray, values: Optional[np.ndarray],
+                    ensure_pad: bool = False
+                    ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], int]:
         """Pad (ids, values) to a power-of-two bucket aimed at the sentinel
-        scratch row so jit traces are shape-stable."""
+        scratch row so jit traces are shape-stable. ``ensure_pad`` keeps at
+        least one sentinel slot (device-out gets hand the bucket itself to
+        the caller as a compact training space; its masked ops need a
+        guaranteed non-live row)."""
         n = len(ids)
         # min bucket = pallas ROW_GROUP (batch must be a group multiple)
         from multiverso_tpu.ops.pallas_rows import ROW_GROUP
-        bucket = max(_next_pow2(n), ROW_GROUP)
+        bucket = max(_next_pow2(n + 1 if ensure_pad else n), ROW_GROUP)
         pad = bucket - n
         ids_p = np.concatenate([ids, np.full(pad, self.sentinel_row, dtype=ids.dtype)])
         vals_p = None
@@ -169,6 +183,14 @@ class MatrixServer(ServerTable):
         scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
         # administrative access (worker id -1) charges slot 0, not slot n-1
         worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
+        if isinstance(values, jax.Array):
+            # Device add (the LocalForward analog: an in-process worker's
+            # delta never touches the host — reference local messages
+            # skipped serialization the same way, communicator.cpp:93-105).
+            # Caller contract: ids unique; pad slots aim at sentinel_row
+            # with exactly-zero deltas.
+            self._process_add_device(row_ids, values, option, worker, scalars)
+            return
         if row_ids is None:
             delta = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
             delta[: self.num_row, : self.num_col] = np.asarray(
@@ -204,6 +226,30 @@ class MatrixServer(ServerTable):
                 else:
                     self._up_to_date[:, touched] = False
 
+    def _process_add_device(self, row_ids, values, option, worker,
+                            scalars) -> None:
+        row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+        n = len(row_ids)
+        if values.shape[0] != n:
+            log.fatal("Matrix.add(device): %d ids but %d value rows",
+                      n, values.shape[0])
+        from multiverso_tpu.ops.pallas_rows import ROW_GROUP
+        bucket = max(_next_pow2(n), ROW_GROUP)
+        ids_p = jnp.asarray(np.concatenate(
+            [row_ids, np.full(bucket - n, self.sentinel_row, np.int32)]))
+        vals_p = _device_pad(values.astype(self.dtype), bucket,
+                             self.padded_cols)
+        if self._linear:
+            self.data = self._scatter_add(self.data, ids_p,
+                                          self._sign * vals_p)
+        else:
+            self.data, self.states = self._row_update(
+                self.data, self.states, ids_p, vals_p, worker, scalars)
+        if self.is_sparse:
+            with self._std_lock:
+                live = row_ids[row_ids < self.num_row]
+                self._up_to_date[:, live] = False
+
     def _is_worker(self, option) -> bool:
         """Administrative access (worker id outside [0, num_slots), e.g.
         checkpoint reads on a server-only node) must not touch any worker's
@@ -213,7 +259,11 @@ class MatrixServer(ServerTable):
         return option is not None and 0 <= option.worker_id < self.num_slots
 
     def process_get(self, request):
-        row_ids, option = request
+        device_out = False
+        if len(request) == 3:  # in-process device-out form
+            row_ids, option, device_out = request
+        else:
+            row_ids, option = request
         if row_ids is None:
             if self.is_sparse and self._is_worker(option):
                 return self._sparse_get(option)
@@ -221,13 +271,16 @@ class MatrixServer(ServerTable):
             out = self.updater.access(self.data)
             return np.asarray(jax.device_get(out))[: self.num_row, : self.num_col]
         row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
-        ids_p, _, n = self._bucket_ids(row_ids, None)
-        rows = np.asarray(jax.device_get(
-            self._gather(self.data, ids_p)))[:n, : self.num_col]
+        ids_p, _, n = self._bucket_ids(row_ids, None, ensure_pad=device_out)
+        gathered = self._gather(self.data, ids_p)
         if self.is_sparse and self._is_worker(option):
             with self._std_lock:
                 self._up_to_date[option.worker_id, row_ids] = True
-        return rows
+        if device_out:
+            # rows stay in HBM: (bucket, padded_cols), slots >= n are
+            # sentinel copies — the caller's compact training space
+            return gathered
+        return np.asarray(jax.device_get(gathered))[:n, : self.num_col]
 
     def _sparse_get(self, option: GetOption):
         """Return only the rows stale for this worker: (ids, rows)."""
@@ -270,6 +323,11 @@ class MatrixServer(ServerTable):
 class MatrixWorker(WorkerTable):
     """Client proxy for a 2-D table: whole or row-subset Get/Add; in sparse
     mode keeps a local row cache refreshed with only-stale-rows Gets."""
+
+    # in-process proxies exchange device arrays with the dispatcher; the
+    # remote subclass overrides this (and the device methods) — callers
+    # must branch on the flag, not on hasattr
+    supports_device_io = True
 
     def __init__(self, num_row: int, num_col: int, dtype: Any = np.float32,
                  updater_type: str = "", init_value: Optional[np.ndarray] = None,
@@ -367,6 +425,45 @@ class MatrixWorker(WorkerTable):
             # sparse get would serve stale values for exactly these rows
             self._caches[0][ids] = raw
         return raw
+
+    # -- device IO (in-process workers only) --------------------------------
+    # The LocalForward analog: a worker sharing the process with the table
+    # exchanges DEVICE arrays with the dispatcher — candidate rows are
+    # gathered in HBM and deltas scattered from HBM, no host copy on either
+    # side. Remote proxies keep the host/wire path. Not available on
+    # is_sparse tables (their client cache is host-resident).
+
+    def get_device_async(self, row_ids: np.ndarray,
+                         option: Optional[GetOption] = None) -> int:
+        """Async candidate-row pull that stays in HBM. The reply (via
+        ``wait_device``) is a ``(bucket, padded_cols)`` jax.Array whose
+        slots ``>= len(row_ids)`` are sentinel copies — usable directly as
+        a compact training space."""
+        if self.is_sparse:
+            log.fatal("device IO is not available on is_sparse tables")
+        option, _ = self._prep_get_option(option, row_ids)
+        return super().get_async((self._norm_ids(row_ids), option, True))
+
+    def wait_device(self, msg_id: int, row_ids: np.ndarray) -> "jax.Array":
+        raw = self.wait(msg_id)
+        self._phase_of.pop(msg_id, None)
+        self.rows_pulled += len(np.asarray(row_ids).reshape(-1))
+        return raw
+
+    def add_device_async(self, values: "jax.Array", row_ids: np.ndarray,
+                         option: Optional[AddOption] = None) -> int:
+        """Async device-resident add. ``values`` is a jax.Array of shape
+        ``(len(row_ids), <=num_col)``; live ids unique, pad slots (if the
+        caller pads) aim at ``num_row`` (the sentinel) with zero deltas."""
+        if self.is_sparse:
+            log.fatal("device IO is not available on is_sparse tables")
+        option = self._default_add_option(option)
+        return super().add_async(
+            (np.asarray(row_ids, np.int32).reshape(-1), values, option))
+
+    @property
+    def sentinel_row(self) -> int:
+        return self._server_table.sentinel_row
 
     # -- add ---------------------------------------------------------------
     def _auto_sparse_rows(self, values, row_ids):

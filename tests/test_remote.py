@@ -417,3 +417,22 @@ def test_remote_bsp_client_crash_names_stalled_worker():
     assert not t.is_alive(), "survivor still wedged after finish_train"
     assert survivor_done.get("ok")
     mv.shutdown()
+
+
+def test_remote_matrix_refuses_device_io():
+    """Device IO is the in-process shortcut; a remote proxy must refuse it
+    loudly (and advertise supports_device_io=False so PSTrainer falls back
+    to the host path) rather than ship device requests over the wire."""
+    mv.init(remote_workers=1)
+    table = mv.create_table("matrix", 8, 4, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    assert table.supports_device_io is True
+    assert rt.supports_device_io is False
+    with pytest.raises(mv.log.FatalError):
+        rt.get_device_async(np.array([1, 2], np.int32))
+    with pytest.raises(mv.log.FatalError):
+        rt.add_device_async(None, np.array([1], np.int32))
+    client.close()
+    mv.shutdown()

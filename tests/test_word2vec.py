@@ -228,3 +228,40 @@ def test_init_params_sharded_on_mesh(mv_env):
     params = init_params(config, mesh)
     assert params["w_in"].shape[0] % 8 == 0  # padded to 8 shards
     assert not params["w_in"].sharding.is_fully_replicated
+
+
+def test_ps_pipelined_train_matches_serial_volume(mv_env):
+    """The pipelined train() (submit block i+1 before finishing block i —
+    the reference's pipeline mode) trains every word exactly once and still
+    learns; device IO keeps rows_pulled bounded by candidates."""
+    vocab = 30
+    rng = np.random.default_rng(3)
+    corpus = _synthetic_corpus(rng, vocab, n=4000)
+    d = _toy_dictionary(corpus, vocab)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            lr=0.1, batch_pairs=512, sample=0.0)
+    trainer = PSTrainer(config, d)
+    blocks = [corpus[i:i + 1000] for i in range(0, len(corpus), 1000)]
+    trainer.train(blocks, epochs=3, log_every_s=1e9)
+    assert trainer.words_trained == 3 * len(corpus)
+    assert trainer.count_table.get(0) == trainer.words_trained
+    score = _cluster_score(trainer.embeddings(), vocab)
+    assert score > 0.2, f"pipelined PS train failed to learn: {score}"
+
+
+def test_ps_device_io_used_in_process(mv_env):
+    """In-process PSTrainer takes the device path (the LocalForward
+    analog): the submit record carries a device stats array, and pulls are
+    still counted per candidate row."""
+    vocab = 30
+    rng = np.random.default_rng(4)
+    corpus = _synthetic_corpus(rng, vocab, n=2000)
+    d = _toy_dictionary(corpus, vocab)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            batch_pairs=512, sample=0.0)
+    trainer = PSTrainer(config, d)
+    pend = trainer.submit_block(corpus[:1000])
+    assert pend is not None and pend["stats"] is not None  # device path
+    loss = trainer.finish_block(pend)
+    assert np.isfinite(loss)
+    assert trainer.input_table.rows_pulled == pend["n_in"]
