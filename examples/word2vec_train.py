@@ -7,7 +7,15 @@ The corpus interleaves sentences drawn entirely from even-id words with
 sentences drawn from odd-id words — training should pull each parity class
 together and push the classes apart.
 
-Run:  python examples/word2vec_train.py          (TPU if available, else CPU)
+Run:  python examples/word2vec_train.py          (synthetic demo)
+      python examples/word2vec_train.py -train_file corpus.txt \
+          -output vectors.txt -size 128 -window 5 -negative 5 -epoch 3 \
+          [-cbow 1] [-hs 1] [-binary 1] [-use_adagrad 1] [-use_ps 1] \
+          [-min_count 5] [-sample 1e-3] [-alpha 0.025] [-block 8192]
+
+The flag surface mirrors the reference binary's argv parser
+(Applications/WordEmbedding/src/util.h:20-44); output is the word2vec
+interchange format readable by gensim et al.
 """
 
 import os
@@ -17,8 +25,69 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from multiverso_tpu.models.vocab import Dictionary
-from multiverso_tpu.models.word2vec import DeviceTrainer, Word2VecConfig
+from multiverso_tpu.models.vocab import Dictionary, iter_token_blocks
+from multiverso_tpu.models.word2vec import (DeviceTrainer, PSTrainer,
+                                            Word2VecConfig, save_embeddings)
+
+
+def run_from_args(argv):
+    """Reference driver shape: -key value argv → corpus training → saved
+    embeddings."""
+    opts = {"size": 128, "window": 5, "negative": 5, "epoch": 1,
+            "min_count": 5, "sample": 1e-3, "alpha": 0.025, "block": 8192,
+            "cbow": 0, "hs": 0, "binary": 0, "use_adagrad": 0, "use_ps": 0,
+            "train_file": "", "output": "vectors.txt"}
+    it = iter(argv)
+    for key in it:
+        name = key.lstrip("-")
+        if name not in opts:
+            raise SystemExit(f"unknown option {key}; have {sorted(opts)}")
+        raw = next(it, None)
+        if raw is None:
+            raise SystemExit(f"option {key} needs a value")
+        default = opts[name]
+        opts[name] = type(default)(raw) if not isinstance(default, str) else raw
+    if not opts["train_file"]:
+        raise SystemExit("-train_file is required")
+    if opts["use_adagrad"] and not opts["use_ps"]:
+        raise SystemExit("-use_adagrad 1 requires -use_ps 1: AdaGrad runs "
+                         "server-side on the parameter-server tables "
+                         "(communicator.cpp:17-32); the device trainer "
+                         "uses plain SGD with the linear lr decay")
+
+    d = Dictionary.from_text_file(opts["train_file"],
+                                  min_count=opts["min_count"])
+    if len(d) == 0:
+        raise SystemExit(f"no words survive -min_count {opts['min_count']}; "
+                         "nothing to train")
+    print(f"vocab: {len(d)} words")
+    config = Word2VecConfig(
+        vocab_size=len(d), dim=opts["size"], window=opts["window"],
+        negatives=opts["negative"], lr=opts["alpha"], sample=opts["sample"],
+        mode="cbow" if opts["cbow"] else "sg",
+        objective="hs" if opts["hs"] else "ns",
+        batch_pairs=8192, block_tokens=opts["block"])
+    # Stream the corpus per epoch like the reference's file re-reads — no
+    # materialized token list; the decay total is known from the vocab.
+    blocks = lambda: iter_token_blocks(opts["train_file"], d, opts["block"])
+    total_words = int(d.counts.sum()) * opts["epoch"]
+    if opts["use_ps"]:
+        import multiverso_tpu as mv
+        mv.init()
+        try:
+            trainer = PSTrainer(config, d,
+                                use_adagrad=bool(opts["use_adagrad"]))
+            trainer.train(blocks, epochs=opts["epoch"],
+                          total_words=total_words)
+            emb = trainer.embeddings()
+        finally:
+            mv.shutdown()
+    else:
+        trainer = DeviceTrainer(config, d)
+        trainer.train(blocks, epochs=opts["epoch"], total_words=total_words)
+        emb = trainer.embeddings()
+    save_embeddings(d, emb, opts["output"], binary=bool(opts["binary"]))
+    print(f"embeddings -> {opts['output']}")
 
 VOCAB, DIM, EPOCHS = 100, 32, 10
 
@@ -62,4 +131,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1:
+        run_from_args(sys.argv[1:])
+    else:
+        main()

@@ -229,6 +229,10 @@ def make_train_step(config: Word2VecConfig, dictionary: Dictionary,
         else:
             codes, points, code_mask = hs_arrays
             out_ids, labels, mask = _hs_targets(predict, codes, points, code_mask)
+        pair_mask = batch.get("pair_mask")
+        if pair_mask is not None:  # tail-padded batch: dead pairs contribute
+            in_weights = in_weights * pair_mask[:, None]  # nothing on either
+            mask = mask * pair_mask[:, None]              # side of the dot
         w_in, w_out, loss = _sgns_core(params["w_in"], params["w_out"],
                                        in_ids, in_weights, out_ids, labels,
                                        mask, lr, config.grad_combine,
@@ -431,22 +435,121 @@ def generate_cbow_batches(block: np.ndarray, window: int,
 
 # -- trainers ---------------------------------------------------------------
 
-def _train_loop(trainer, blocks: Iterable[np.ndarray], epochs: int,
-                log_every_s: float, label: str) -> None:
+def save_embeddings(dictionary: Dictionary, embeddings: np.ndarray,
+                    address: str, binary: bool = False) -> None:
+    """Write embeddings in the word2vec interchange format the reference's
+    ``SaveEmbedding`` produced (distributed_wordembedding.cpp:263-306):
+    header ``"V D\\n"``, then per word either ``"word v1 … vD\\n"`` (text)
+    or ``"word " + D float32 + "\\n"`` (binary, word2vec.c-compatible).
+    ``address`` is a URI — any registered Stream scheme works."""
+    from multiverso_tpu import io as mv_io
+    emb = np.asarray(embeddings, np.float32)
+    v = len(dictionary.words)
+    if emb.shape[0] < v:
+        log.fatal("save_embeddings: %d words but %d rows", v, emb.shape[0])
+    with mv_io.get_stream(address, "w") as stream:
+        stream.write(f"{v} {emb.shape[1]}\n".encode())
+        for i, word in enumerate(dictionary.words):
+            stream.write(word.encode() + b" ")
+            if binary:
+                stream.write(emb[i].tobytes() + b"\n")
+            else:
+                stream.write(" ".join(f"{x:g}" for x in emb[i]).encode()
+                             + b"\n")
+
+
+def load_embeddings(address: str, binary: bool = False
+                    ) -> Tuple[list, np.ndarray]:
+    """Inverse of :func:`save_embeddings`: returns (words, (V, D) matrix)."""
+    from multiverso_tpu import io as mv_io
+    with mv_io.get_stream(address, "r") as stream:
+        data = stream.read()
+    head, _, rest = data.partition(b"\n")
+    v, dim = (int(x) for x in head.split())
+    if v == 0:
+        return [], np.zeros((0, dim), np.float32)
+    words, rows = [], []
+    pos = 0
+    for _ in range(v):
+        sp = rest.index(b" ", pos)
+        words.append(rest[pos:sp].decode())
+        if binary:
+            vec = np.frombuffer(rest, np.float32, dim, sp + 1)
+            pos = sp + 1 + 4 * dim + 1  # + trailing newline
+        else:
+            nl = rest.index(b"\n", sp)
+            vec = np.array(rest[sp + 1:nl].split(), np.float32)
+            pos = nl + 1
+        rows.append(vec)
+    return words, np.stack(rows)
+
+
+def _decayed_lr(lr0: float, words_trained: int, total_words: int) -> float:
+    """The reference's linear lr schedule (wordembedding.cpp:38-46):
+    lr = lr0 * (1 - words_trained/(total+1)), floored at lr0 * 1e-4.
+    Skipped under AdaGrad, like the reference."""
+    frac = 1.0 - words_trained / (float(total_words) + 1.0)
+    return lr0 * max(frac, 1e-4)
+
+
+def _plan_blocks(blocks, epochs: int, total_words: Optional[int]):
+    """Resolve a block plan for the epoch loops: ``blocks`` is either a
+    materialized iterable (reused each epoch) or a zero-arg callable
+    yielding a fresh stream per epoch (the reference re-read its train
+    file per epoch rather than holding the corpus in RAM). Returns
+    (per_epoch_fn, total_raw_words); streaming callers must supply
+    ``total_words`` since the stream length is unknown up front."""
+    if callable(blocks):
+        if total_words is None:
+            log.fatal("streaming blocks require total_words "
+                      "(e.g. dictionary.counts.sum() * epochs)")
+        return blocks, total_words
+    blocks = list(blocks)
+    if total_words is None:
+        total_words = sum(len(b) for b in blocks) * epochs
+    return (lambda: blocks), total_words
+
+
+def _train_loop(trainer, blocks, epochs: int, log_every_s: float,
+                label: str, total_words: Optional[int] = None,
+                pipelined: bool = False) -> None:
     """Shared epoch loop with throttled words/sec logging (the reference's
-    ``Trainer::TrainIteration`` log shape) — used by both trainers."""
+    ``Trainer::TrainIteration`` log shape) — used by both trainers. Applies
+    the reference's linear lr decay over the planned word volume; decay
+    progress counts RAW words fed (the reference counts words read before
+    subsampling, wordembedding.cpp:38-46), so the schedule reaches its
+    floor regardless of the subsample rate.
+
+    ``pipelined`` drives trainers exposing submit_block/finish_block
+    (the PS path): block i+1 is submitted before block i's completions
+    are awaited, so each block's lr is one block stale — like the
+    reference's asynchronously-shared word count."""
     t0 = time.time()
     last = t0
-    blocks = list(blocks)
+    per_epoch, total = _plan_blocks(blocks, epochs, total_words)
+    decay = not getattr(trainer, "use_adagrad", False)
+    seen = 0
+    pending = None
     for _ in range(epochs):
-        for block in blocks:
-            trainer.train_block(block)
+        for block in per_epoch():
+            lr = (_decayed_lr(trainer.config.lr, seen, total)
+                  if decay else None)
+            seen += len(block)
+            if pipelined:
+                nxt = trainer.submit_block(block, lr=lr)
+                if pending is not None:
+                    trainer.finish_block(pending)
+                pending = nxt
+            else:
+                trainer.train_block(block, lr=lr)
             now = time.time()
             if now - last > log_every_s:
                 rate = trainer.words_trained / (now - t0)
                 log.info("%sWords/sec: %.0fk  (trained %d)",
                          label, rate / 1e3, trainer.words_trained)
                 last = now
+    if pending is not None:
+        trainer.finish_block(pending)
 
 class DeviceTrainer:
     """HBM-resident training: embeddings live sharded on the mesh; the hot
@@ -471,17 +574,30 @@ class DeviceTrainer:
         self.words_trained = 0
 
     def _batches(self, block: np.ndarray) -> Iterator[Dict[str, jnp.ndarray]]:
+        """Fixed-shape (B,) batches; the tail is zero-padded with a
+        ``pair_mask`` (consumed in-jit) rather than dropped, so blocks or
+        corpora smaller than ``batch_pairs`` still train. Shapes stay
+        static — one extra jit cache entry for masked batches."""
         bp = self.config.batch_pairs
         if self.config.mode == "sg":
-            centers, contexts = generate_sg_pairs(block, self.config.window, self.rng)
-            for i in range(0, len(centers) - bp + 1, bp):
-                yield {"centers": jnp.asarray(centers[i:i + bp]),
-                       "contexts": jnp.asarray(contexts[i:i + bp])}
+            centers, other = generate_sg_pairs(block, self.config.window,
+                                               self.rng)
+            ctx_key = "contexts"
         else:
-            centers, ctx = generate_cbow_batches(block, self.config.window, self.rng)
-            for i in range(0, len(centers) - bp + 1, bp):
-                yield {"centers": jnp.asarray(centers[i:i + bp]),
-                       "context_block": jnp.asarray(ctx[i:i + bp])}
+            centers, other = generate_cbow_batches(block, self.config.window,
+                                                   self.rng)
+            ctx_key = "context_block"
+        for i in range(0, len(centers), bp):
+            c, o = centers[i:i + bp], other[i:i + bp]
+            if len(c) == bp:
+                yield {"centers": jnp.asarray(c), ctx_key: jnp.asarray(o)}
+            else:
+                n = len(c)
+                pad = ((0, bp - n),) + ((0, 0),) * (o.ndim - 1)
+                yield {"centers": jnp.asarray(np.pad(c, (0, bp - n))),
+                       ctx_key: jnp.asarray(np.pad(o, pad)),
+                       "pair_mask": jnp.asarray(
+                           (np.arange(bp) < n).astype(np.float32))}
 
     def train_block(self, block: np.ndarray, lr: Optional[float] = None) -> float:
         block = subsample_block(block, self.keep, self.rng)
@@ -506,9 +622,10 @@ class DeviceTrainer:
         self.words_trained += len(block)
         return float(np.mean([float(l) for l in losses])) if losses else 0.0
 
-    def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
-              log_every_s: float = 10.0) -> None:
-        _train_loop(self, blocks, epochs, log_every_s, "")
+    def train(self, blocks, epochs: int = 1, log_every_s: float = 10.0,
+              total_words: Optional[int] = None) -> None:
+        _train_loop(self, blocks, epochs, log_every_s, "",
+                    total_words=total_words)
         jax.block_until_ready(self.params["w_in"])
 
     def embeddings(self) -> np.ndarray:
@@ -845,32 +962,17 @@ class PSTrainer:
                                  "pairs": pend["pairs"]}
         return float(loss_sum) / max(float(w_sum), 1.0)
 
-    def train(self, blocks: Iterable[np.ndarray], epochs: int = 1,
-              log_every_s: float = 10.0) -> None:
+    def train(self, blocks, epochs: int = 1, log_every_s: float = 10.0,
+              total_words: Optional[int] = None) -> None:
         """Pipelined epoch loop: block i+1's host shaping + candidate pulls
         + dispatch are issued BEFORE block i's completions are awaited —
         the reference's pipeline mode (one thread prefetched the next
         block's rows while others trained,
         distributed_wordembedding.cpp:202-223), realized here as
-        submit-ahead over the async table API instead of extra threads."""
-        t0 = time.time()
-        last = t0
-        blocks = list(blocks)
-        pending = None
-        for _ in range(epochs):
-            for block in blocks:
-                nxt = self.submit_block(block)
-                if pending is not None:
-                    self.finish_block(pending)
-                pending = nxt
-                now = time.time()
-                if now - last > log_every_s:
-                    rate = self.words_trained / (now - t0)
-                    log.info("PS Words/sec: %.0fk  (trained %d)",
-                             rate / 1e3, self.words_trained)
-                    last = now
-        if pending is not None:
-            self.finish_block(pending)
+        submit-ahead over the async table API instead of extra threads.
+        Decay and logging live in ``_train_loop``."""
+        _train_loop(self, blocks, epochs, log_every_s, "PS ",
+                    total_words=total_words, pipelined=True)
 
     def embeddings(self) -> np.ndarray:
         return self.input_table.get()

@@ -265,3 +265,117 @@ def test_ps_device_io_used_in_process(mv_env):
     loss = trainer.finish_block(pend)
     assert np.isfinite(loss)
     assert trainer.input_table.rows_pulled == pend["n_in"]
+
+
+def test_save_load_embeddings_roundtrip(tmp_path):
+    """word2vec interchange format (reference SaveEmbedding): text and
+    binary, scheme-agnostic (here plain files)."""
+    from multiverso_tpu.models.word2vec import load_embeddings, save_embeddings
+
+    d = Dictionary()
+    d.words = ["alpha", "beta", "gamma"]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.array([5, 4, 3], np.int64)
+    emb = np.random.default_rng(0).normal(size=(3, 8)).astype(np.float32)
+
+    txt = str(tmp_path / "emb.txt")
+    save_embeddings(d, emb, txt, binary=False)
+    first = open(txt, "rb").readline()
+    assert first == b"3 8\n"
+    words, mat = load_embeddings(txt, binary=False)
+    assert words == d.words
+    np.testing.assert_allclose(mat, emb, rtol=1e-5)  # %g text round-trip
+
+    binp = str(tmp_path / "emb.bin")
+    save_embeddings(d, emb, binp, binary=True)
+    words_b, mat_b = load_embeddings(binp, binary=True)
+    assert words_b == d.words
+    np.testing.assert_array_equal(mat_b, emb)  # binary is exact
+
+
+def test_lr_decays_linearly_over_training():
+    """The reference's schedule: lr0 * (1 - trained/total), floored at
+    lr0 * 1e-4 (wordembedding.cpp:38-46)."""
+    from multiverso_tpu.models.word2vec import _decayed_lr
+
+    assert _decayed_lr(0.025, 0, 1000) == pytest.approx(0.025, rel=1e-3)
+    assert _decayed_lr(0.025, 500, 1000) == pytest.approx(0.0125, rel=1e-2)
+    assert _decayed_lr(0.025, 10_000, 1000) == pytest.approx(0.025e-4)
+
+
+def test_lr_decay_reaches_floor_despite_subsampling():
+    """Decay progress is measured in RAW words fed, not post-subsample
+    words_trained, so the schedule anneals to ~0 even when subsampling
+    drops a large fraction of tokens (the reference counts words read,
+    wordembedding.cpp:38-46)."""
+    from multiverso_tpu.models.word2vec import _train_loop
+
+    class Spy:
+        class config:
+            lr = 0.1
+        words_trained = 0
+        lrs = []
+
+        def train_block(self, block, lr=None):
+            self.lrs.append(lr)
+            # emulate aggressive subsampling: words_trained advances at
+            # a third of the raw rate
+            self.words_trained += len(block) // 3
+
+    spy = Spy()
+    blocks = [np.zeros(90, np.int32)] * 10
+    _train_loop(spy, blocks, epochs=1, log_every_s=1e9, label="")
+    # last block's lr computed with seen = 9/10 of total raw words (810),
+    # NOT words_trained (which subsampling held to a third of that)
+    assert spy.lrs[-1] == pytest.approx(0.1 * (1 - 810 / 901.0), rel=1e-2)
+    assert spy.lrs[0] == pytest.approx(0.1, rel=1e-3)
+
+
+def test_train_loop_streams_blocks_per_epoch():
+    """A callable block source is re-invoked per epoch (the reference
+    re-read its train file) and requires an explicit total_words."""
+    from multiverso_tpu.models.word2vec import _train_loop
+
+    calls = []
+
+    def source():
+        calls.append(1)
+        return iter([np.zeros(10, np.int32)] * 2)
+
+    class Spy:
+        class config:
+            lr = 0.1
+        words_trained = 0
+        seen = []
+
+        def train_block(self, block, lr=None):
+            self.seen.append(lr)
+
+    spy = Spy()
+    _train_loop(spy, source, epochs=3, log_every_s=1e9, label="",
+                total_words=60)
+    assert len(calls) == 3          # fresh stream per epoch
+    assert len(spy.seen) == 6
+    assert spy.seen[0] > spy.seen[-1]
+
+
+@pytest.mark.parametrize("mode,objective", [("cbow", "ns"), ("sg", "hs"),
+                                            ("cbow", "hs")])
+def test_small_blocks_still_train_pair_mode(mode, objective):
+    """Pair-mode batches smaller than batch_pairs are tail-padded with a
+    pair_mask, not dropped — a corpus smaller than one batch must still
+    move the parameters (regression: they previously trained nothing)."""
+    cfg = Word2VecConfig(vocab_size=30, dim=8, window=2, negatives=3,
+                         lr=0.1, sample=0.0, mode=mode, objective=objective,
+                         batch_pairs=4096, seed=1)
+    d = make_dictionary(cfg.vocab_size)
+    t = DeviceTrainer(cfg, d)
+    init = t.embeddings().copy()
+    rng = np.random.default_rng(0)
+    block = rng.integers(0, cfg.vocab_size, 200).astype(np.int32)
+    loss = t.train_block(block)
+    assert np.isfinite(loss) and loss > 0.0
+    # w_out starts at zeros so step 1 only moves w_out; w_in moves after
+    t.train_block(block)
+    moved = np.abs(t.embeddings() - init).max()
+    assert moved > 1e-6, "sub-batch_pairs blocks trained nothing"
