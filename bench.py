@@ -217,14 +217,23 @@ def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
         f1, f2 = makef(k1), makef(k2)
         _fetch(f1(*args))
         _fetch(f2(*args))
-        def best(f):
-            b = float("inf")
+        def timed(f):
+            t0 = time.perf_counter()
+            _fetch(f(*args))
+            return time.perf_counter() - t0
+        # The tunneled TPU is shared: external load arrives in multi-second
+        # bursts (observed: the same op measuring 26µs and 99µs in adjacent
+        # processes). Interleave f1/f2 reps across 6 phases spread over
+        # ~7.5s so a burst must span the whole window to corrupt the
+        # slope; per-point min is sound — noise only ever adds time. The
+        # sleeps are pointless off-TPU (no shared tunnel), so skip them.
+        b1 = b2 = float("inf")
+        for phase in range(6 if on_tpu else 1):
+            if phase:
+                time.sleep(1.5)  # bursts last seconds; outlast them
             for _ in range(3):
-                t0 = time.perf_counter()
-                _fetch(f(*args))
-                b = min(b, time.perf_counter() - t0)
-            return b
-        b1, b2 = best(f1), best(f2)
+                b1 = min(b1, timed(f1))
+                b2 = min(b2, timed(f2))
         per_op = (b2 - b1) / (k2 - k1)
         # timer noise on fast backends can invert the two points; fall back
         # to the k2 average rather than report an absurd slope figure
@@ -251,7 +260,19 @@ def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
             best = min(best, time.perf_counter() - t0)
         return best
     n_extra = 10 if on_tpu else 2
-    dense_per_pass = (dense_time(n_extra) - dense_time(0)) / n_extra
+    # per-point minima over interleaved samples: each min independently
+    # converges to the true time (noise only adds), so the difference is
+    # burst-robust — unlike per-pair increments, where a burst inflating
+    # the baseline point yields a tiny positive increment and an absurd
+    # multi-thousand-GB/s figure
+    tns, t0s = [], []
+    for _ in range(3):
+        tns.append(dense_time(n_extra))
+        t0s.append(dense_time(0))
+    inc = min(tns) - min(t0s)
+    # fallback (sustained load made the baseline dearer than the passes):
+    # charge the full n_extra run — an upper bound on per-pass cost
+    dense_per_pass = inc / n_extra if inc > 0 else min(tns) / n_extra
     dense_bytes = rows * padded_cols * 4 * 2  # read + write
 
     batch_bytes = batch_rows * cols * 4
