@@ -121,6 +121,106 @@ class PytreeParamManager(ParamManager):
 
     sync_all_param = ParamManager.sync_all_param
 
+    def worker_view(self, device: bool = False) -> "PytreeWorkerSync":
+        """Per-worker syncer over this manager's SHARED table. Each view
+        owns its own last-synced baseline, which is the reference's actual
+        topology — every process tracked its own delta base
+        (``param_manager.py:70-83`` ran once per process). Sharing one
+        manager between threads instead makes worker A's push subtract
+        worker B's freshly-merged work (their baselines alias). Views
+        need no lock: table add/get are dispatcher-serialized.
+
+        ``device=True`` keeps the whole sync in HBM (jitted flatten/split +
+        the table's device add/get): no host copy of the model per sync —
+        the TPU-era replacement for the reference's host-side serialize
+        path, and the difference between percent-level and 20x sync
+        overhead on tunneled chips."""
+        return PytreeWorkerSync(self, device=device)
+
+
+class PytreeWorkerSync:
+    """See :meth:`PytreeParamManager.worker_view`. Starts from the current
+    global table value; ``sync(tree)`` pushes this worker's delta and
+    returns the merged global tree."""
+
+    def __init__(self, manager: "PytreeParamManager",
+                 device: bool = False) -> None:
+        self._jax = manager._jax
+        self._treedef = manager._treedef
+        self._shapes = manager._shapes
+        self._dtypes = manager._dtypes
+        self._sizes = manager._sizes
+        self._table = manager.table
+        self._device = bool(device) and getattr(
+            self._table, "supports_device_io", False)
+        if self._device:
+            jax = self._jax
+
+            import jax.numpy as jnp_mod
+
+            @jax.jit
+            def delta_fn(new, last):
+                return [n - l for n, l in zip(new, last)]
+
+            @jax.jit
+            def copy_fn(ls):
+                return [jnp_mod.copy(x) for x in ls]
+
+            self._delta_fn, self._copy_fn = delta_fn, copy_fn
+            # _last is a list of SINGLE-DEVICE leaves (the server's leaf
+            # codec commits them): worker-thread math on them never runs
+            # cross-shard collectives, which must stay on the dispatcher
+            template = [jax.numpy.zeros(s, d)
+                        for s, d in zip(self._shapes, self._dtypes)]
+            self._last = self._table.wait(
+                self._table.get_leaves_async(template))
+        else:
+            self._last = self._table.get()
+
+    def _unflatten(self, flat) -> Any:
+        if self._device:
+            return self._jax.tree_util.tree_unflatten(self._treedef,
+                                                      list(flat))
+        import jax.numpy as jnp
+        leaves, n = [], 0
+        for shape, dtype, size in zip(self._shapes, self._dtypes,
+                                      self._sizes):
+            leaves.append(jnp.asarray(
+                flat[n:n + size].reshape(shape).astype(dtype)))
+            n += size
+        return self._jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    @property
+    def params(self) -> Any:
+        if self._device:  # hand out copies; callers may donate them
+            return self._unflatten(self._copy_fn(self._last))
+        return self._unflatten(self._last)
+
+    def sync(self, tree: Any) -> Any:
+        leaves, treedef = self._jax.tree_util.tree_flatten(tree)
+        if treedef != self._treedef:
+            mv.log.fatal("pytree structure changed across sync")
+        if self._device:
+            # HBM end-to-end, single hop: one jitted (single-device) delta
+            # on the worker thread, then the server's fused leaf sync —
+            # flatten, update, and split all on the dispatcher thread
+            delta = self._delta_fn(leaves, self._last)
+            merged = self._table.wait(self._table.sync_leaves_async(delta))
+            if merged is None:  # deferred-apply server (BSP/deterministic)
+                merged = self._table.wait(
+                    self._table.get_leaves_async(delta))
+            # baseline keeps its OWN buffers: the caller typically feeds
+            # the returned tree into a donating train step, which would
+            # delete a shared _last out from under the next delta
+            self._last = self._copy_fn(merged)
+            return self._unflatten(merged)
+        flat = np.concatenate(
+            [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
+        ) if leaves else np.zeros(0, np.float32)
+        self._table.add(flat - self._last)
+        self._last = self._table.get()
+        return self._unflatten(self._last)
+
 
 class TorchParamManager(ParamManager):
     """Manage a ``torch.nn.Module``'s parameters."""

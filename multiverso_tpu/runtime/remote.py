@@ -353,9 +353,30 @@ class _RemoteArrayWorker(ArrayWorker):
         self.size = int(spec["size"])
         self.dtype = np.dtype(spec["dtype"])
 
+    # device IO is in-process only (a remote hop IS a host hop); without
+    # this override the class attribute inherited from ArrayWorker would
+    # send per-leaf device requests over TCP
+    supports_device_io = False
+
     def get_device(self):
         raise RuntimeError("get_device() needs mesh residency; remote "
                            "clients are off-mesh — use get()")
+
+    def get_device_async(self, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "get/get_async (host arrays)")
+
+    def add_device_async(self, delta, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "add/add_async (host arrays)")
+
+    def sync_leaves_async(self, delta_leaves, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "add/add_async (host arrays)")
+
+    def get_leaves_async(self, template_leaves, option=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "get/get_async (host arrays)")
 
 
 class _RemoteMatrixWorker(MatrixWorker):

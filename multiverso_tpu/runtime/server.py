@@ -95,8 +95,9 @@ class Server:
     def _process_add(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_ADD_MSG"):
             request, completion = msg.data
-            self._tables[msg.table_id].process_add(request)
-            completion.done(None)
+            # process_add may return a fused-get payload (ArrayTable's
+            # add+get sync path); plain adds return None as before
+            completion.done(self._tables[msg.table_id].process_add(request))
 
     def _process_get(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_GET_MSG"):
@@ -294,9 +295,11 @@ class SyncServer(Server):
         # round-r Adds wait until every worker has finished its round-(r-1) Gets
         if self._min_gets(tid) >= round_ - 1:
             request, completion = msg.data
-            self._tables[tid].process_add(request)
+            # forward the fused-sync reply (ArrayTable leaf mode) rather
+            # than discarding it — the client would otherwise re-run the
+            # whole merged-value split in a fallback get
+            completion.done(self._tables[tid].process_add(request))
             self._add_clock[tid][worker] = round_
-            completion.done(None)
             self._drain(tid)
         else:
             self._pending_add[tid].append(msg)
@@ -350,9 +353,9 @@ class SyncServer(Server):
                 round_ = self._add_clock[table_id][worker] + 1
                 if self._min_gets(table_id) >= round_ - 1:
                     request, completion = msg.data
-                    self._tables[table_id].process_add(request)
+                    completion.done(
+                        self._tables[table_id].process_add(request))
                     self._add_clock[table_id][worker] = round_
-                    completion.done(None)
                     progressed = True
                 else:
                     still.append(msg)

@@ -28,9 +28,10 @@ from multiverso_tpu.tables.base import ServerTable, WorkerTable
 from multiverso_tpu.updaters import AddOption, GetOption, Updater, get_updater
 
 
-def _make_whole_update(updater: Updater):
-    """Jit one whole-table update closed over the updater. Donated so the
-    HBM buffers are reused in place."""
+def _make_whole_update(updater: Updater, jit: bool = True):
+    """One whole-table update closed over the updater. Jitted+donated so
+    the HBM buffers are reused in place; ``jit=False`` returns the raw
+    traceable function for embedding in larger fused jits."""
 
     def f(data, states, delta, worker, scalars):
         if updater.per_worker_state:
@@ -46,7 +47,7 @@ def _make_whole_update(updater: Updater):
             new_states = {k: new_sliced[k][None] for k in states}
         return new_data, new_states
 
-    return jax.jit(f, donate_argnums=(0, 1))
+    return jax.jit(f, donate_argnums=(0, 1)) if jit else f
 
 
 class ArrayServer(ServerTable):
@@ -78,24 +79,149 @@ class ArrayServer(ServerTable):
                 np.zeros((worker_dim,) + tuple(shape_suffix), dtype=sdtype), s_shard)
 
         self._update = _make_whole_update(self.updater)
+        self._codecs: Dict = {}  # leaf-signature -> (to_flat, from_flat)
 
     # -- server ops --------------------------------------------------------
-    def process_add(self, request: Tuple[np.ndarray, Optional[AddOption]]) -> None:
-        delta, option = request
+    def _leaf_codec(self, leaves):
+        """jitted (to_flat, from_flat) for a list-of-arrays signature.
+        from_flat's outputs are committed to ONE device (out_shardings):
+        worker threads then compute on single-device arrays only, so every
+        cross-shard collective stays on the dispatcher thread — concurrent
+        sharded executions from N worker threads deadlock the CPU
+        backend's rendezvous (and serialize badly on real meshes)."""
+        key = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        codec = self._codecs.get(key)
+        if codec is not None:
+            return codec
+        shapes = [tuple(l.shape) for l in leaves]
+        dtypes = [l.dtype for l in leaves]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        if sum(sizes) != self.size:
+            log.fatal("leaf signature totals %d, table size %d",
+                      sum(sizes), self.size)
+        pad, dtype = self.padded - self.size, self.dtype
+
+        def to_flat_impl(ls):
+            flat = (jnp.concatenate(
+                [jnp.ravel(x).astype(dtype) for x in ls])
+                if ls else jnp.zeros(0, dtype))
+            return jnp.pad(flat, (0, pad)) if pad else flat
+
+        to_flat = jax.jit(to_flat_impl)
+
+        from jax.sharding import SingleDeviceSharding
+        dev = SingleDeviceSharding(jax.devices()[0])
+        # on a 1-device mesh (the common real-TPU case) sharded == single
+        # device, so both boundary transfers are pure overhead (~1 tunnel
+        # dispatch per leaf) — skip them
+        multi = self.mesh is not None and self.mesh.size > 1
+
+        def split_impl(flat):
+            out, n = [], 0
+            for shape, dt, size in zip(shapes, dtypes, sizes):
+                out.append(flat[n:n + size].reshape(shape).astype(dt))
+                n += size
+            return out
+
+        split = jax.jit(split_impl)
+
+        def from_flat(flat):
+            # split stays sharded in-jit (jit rejects mixed device sets in
+            # out_shardings); the gather to ONE device is an explicit
+            # transfer issued here, on the dispatcher thread
+            leaves = split(flat)
+            return jax.device_put(leaves, dev) if multi else leaves
+
+        fused = None
+        if not multi:
+            # single-device mesh: the whole sync — flatten, update,
+            # access, split — is ONE compiled dispatch (mixed device sets
+            # block this on sharded meshes, which use the staged path)
+            update_raw = _make_whole_update(self.updater, jit=False)
+            access = self.updater.access
+
+            def fused_impl(data, states, ls, worker, scalars):
+                data, states = update_raw(data, states, to_flat_impl(ls),
+                                          worker, scalars)
+                return data, states, split_impl(access(data))
+
+            fused = jax.jit(fused_impl, donate_argnums=(0, 1))
+
+        codec = (to_flat, from_flat, fused)
+        self._codecs[key] = codec
+        return codec
+
+    def process_add(self, request) -> Optional[list]:
+        want_get = False
+        leaf_mode = isinstance(request[0], str) and request[0] == "leaves"
+        if leaf_mode:
+            # fused whole-model sync: delta arrives as the caller's leaf
+            # list, the merged value returns the same way — one hop, all
+            # sharded math right here on the dispatcher thread
+            _, leaves, option = request
+            option = option or AddOption()
+            to_flat, from_flat, fused = self._leaf_codec(leaves)
+            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
+            worker = jnp.int32(max(option.worker_id, 0)
+                               % max(1, self.num_workers))
+            if fused is not None:  # single-device: one compiled dispatch
+                self.data, self.states, out = fused(
+                    self.data, self.states, list(leaves), worker, scalars)
+                return out
+            # staged multi-device path: explicit scatter to the table
+            # sharding (the jitted update can't take mixed device sets)
+            delta = jax.device_put(
+                to_flat(list(leaves)),
+                mesh_lib.table_sharding(self.mesh, ndim=1))
+            self.data, self.states = self._update(self.data, self.states,
+                                                  delta, worker, scalars)
+            return from_flat(self.updater.access(self.data))
+        if len(request) == 3:  # fused add+get (flat device sync path)
+            delta, option, want_get = request
+        else:
+            delta, option = request
         option = option or AddOption()
-        delta = np.asarray(delta, dtype=self.dtype).reshape(-1)
+        # host deltas are normalized to device arrays up front; a
+        # jax.Array input never touches the host (the TPU-era ASGD path —
+        # param sync is HBM-to-HBM)
+        if not isinstance(delta, jax.Array):
+            delta = jnp.asarray(np.asarray(delta, dtype=self.dtype))
+        delta = delta.reshape(-1).astype(self.dtype)
         if delta.size != self.size:
             log.fatal("ArrayTable.add: delta size %d != table size %d",
                       delta.size, self.size)
         if self.padded != self.size:
-            delta = np.pad(delta, (0, self.padded - self.size))
+            delta = jnp.pad(delta, (0, self.padded - self.size))
         scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
         # administrative access (worker id -1) charges slot 0, not slot n-1
         worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
         self.data, self.states = self._update(self.data, self.states,
-                                              jnp.asarray(delta), worker, scalars)
+                                              delta, worker, scalars)
+        if want_get:
+            # fused reply: the post-add global value, still in HBM — one
+            # dispatcher hop for the whole ASGD sync instead of two
+            return self._device_value()
+        return None
 
-    def process_get(self, request: Optional[GetOption]) -> np.ndarray:
+    def _device_value(self) -> jax.Array:
+        out = self.updater.access(self.data)[: self.size]
+        # jnp.copy: with an identity access and size == padded the slice
+        # can alias self.data, whose buffer the NEXT add donates — the
+        # caller's reply would be deleted out from under it
+        return jnp.copy(out)
+
+    def process_get(self, request) -> np.ndarray:
+        device_out = False
+        if isinstance(request, tuple):
+            if isinstance(request[0], str) and request[0] == "leaves":
+                # leaf-shaped device get: reply mirrors the template's
+                # shapes/dtypes, committed single-device (see _leaf_codec)
+                _, template, _option = request
+                _, from_flat, _ = self._leaf_codec(template)
+                return from_flat(self.updater.access(self.data))
+            request, device_out = request  # in-process device-out form
+        if device_out:
+            return self._device_value()  # stays in HBM, donation-safe
         out = self.updater.access(self.data)
         return np.asarray(jax.device_get(out))[: self.size]
 
@@ -153,6 +279,47 @@ class ArrayWorker(WorkerTable):
         return option
 
     # -- TPU-era fast path -------------------------------------------------
+    supports_device_io = True
+
     def get_device(self) -> jax.Array:
         """The live sharded device array (valid until the next add)."""
         return self._server_table.data
+
+    def get_device_async(self, option: Optional[GetOption] = None) -> int:
+        """Dispatcher-ordered Get whose reply STAYS in HBM: a (size,)
+        jax.Array reflecting every add queued before it. Unlike
+        :meth:`get_device` this is safe against concurrent adds."""
+        return super().get_async((option, True))
+
+    def add_device_async(self, delta: "jax.Array",
+                         option: Optional[AddOption] = None) -> int:
+        """Async add of a DEVICE-resident (size,) delta — no host copy;
+        the dispatcher applies it via the same jitted updater."""
+        option = self._default_option(option)
+        return super().add_async((delta, option))
+
+    def sync_device_async(self, delta: "jax.Array",
+                          option: Optional[AddOption] = None) -> int:
+        """Fused device add+get: ONE dispatcher hop whose reply is the
+        post-add global value in HBM. Deferred-apply servers (BSP /
+        deterministic) reply None — callers fall back to an explicit
+        get_device_async."""
+        option = self._default_option(option)
+        return super().add_async((delta, option, True))
+
+    def sync_leaves_async(self, delta_leaves: list,
+                          option: Optional[AddOption] = None) -> int:
+        """Fused whole-model sync in the caller's own leaf shapes: ONE
+        dispatcher hop; the reply is the merged value as a list of
+        SINGLE-DEVICE arrays (safe for concurrent worker-thread compute —
+        see ``ArrayServer._leaf_codec``). The leaf sizes must total the
+        table size. Deferred-apply servers reply None; fall back to
+        ``get_leaves_async``."""
+        option = self._default_option(option)
+        return super().add_async(("leaves", list(delta_leaves), option))
+
+    def get_leaves_async(self, template_leaves: list,
+                         option: Optional[GetOption] = None) -> int:
+        """Device get shaped like ``template_leaves`` (values unused, only
+        shapes/dtypes), single-device committed."""
+        return super().get_async(("leaves", list(template_leaves), option))

@@ -122,3 +122,51 @@ def test_dcasgd_compensates_delay(mv_env):
     # backup=0, data=0: comp = g + 0.5*g*g*(0-0) = g; data = -0.1*g
     table.add(g, option=opt)
     np.testing.assert_allclose(table.get(), -0.1 * g, rtol=1e-5)
+
+
+def test_device_io_add_get_and_fused_sync(mv_env):
+    """TPU-era device path: adds/gets that never leave HBM, and the fused
+    add+get (sync_device_async) whose single dispatcher hop replies with
+    the post-add global value."""
+    import jax
+    import jax.numpy as jnp
+
+    table = mv.create_table("array", 10, np.float32)
+    table.add(np.arange(10, dtype=np.float32))
+
+    # device add: host never sees the delta
+    table.wait(table.add_device_async(jnp.ones(10, jnp.float32)))
+    out = table.wait(table.get_device_async())
+    assert isinstance(out, jax.Array)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(10, dtype=np.float32) + 1)
+
+    # fused: one hop, reply = post-add value, still on device
+    merged = table.wait(table.sync_device_async(
+        jnp.full(10, 2.0, jnp.float32)))
+    assert isinstance(merged, jax.Array)
+    np.testing.assert_allclose(np.asarray(merged),
+                               np.arange(10, dtype=np.float32) + 3)
+    # host view agrees
+    np.testing.assert_allclose(table.get(),
+                               np.arange(10, dtype=np.float32) + 3)
+
+
+def test_device_worker_view_matches_host_view(mv_env):
+    """PytreeWorkerSync device mode must be numerically identical to the
+    host path."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    tree = {"a": jnp.zeros((2, 3), jnp.float32), "b": jnp.zeros(4, jnp.float32)}
+    pm = PytreeParamManager(tree)
+    host = pm.worker_view(device=False)
+    dev = pm.worker_view(device=True)
+    t1 = {"a": jnp.full((2, 3), 1.5, jnp.float32),
+          "b": jnp.arange(4, dtype=jnp.float32)}
+    h = host.sync(t1)
+    d = dev.sync(jax.tree.map(jnp.zeros_like, t1))  # dev pushes zeros
+    # dev's pull must observe host's push exactly
+    np.testing.assert_allclose(np.asarray(d["a"]), np.asarray(h["a"]))
+    np.testing.assert_allclose(np.asarray(d["b"]), np.asarray(h["b"]))
