@@ -61,12 +61,26 @@ class Word2VecConfig:
     # corpora); hot rows on unsubsampled zipf corpora no longer blow up from
     # dup_count×lr steps applied at the same stale weights.
     max_row_step: float = 1.0
+    # Block-mode negative sharing: one K-sample set serves a group of
+    # neg_sharing consecutive centers (1 = per-center, the word2vec.c-like
+    # default). Negatives are noise — sharing across a few adjacent
+    # centers preserves quality (convergence-tested at 8) while cutting
+    # negative row gather/scatter traffic by the factor and turning the
+    # negative score into a bigger, MXU-friendlier contraction.
+    neg_sharing: int = 1
     seed: int = 1
 
     def __post_init__(self):
         if self.grad_combine not in ("sum", "mean"):
             raise ValueError(
                 f"grad_combine must be 'sum' or 'mean', got {self.grad_combine!r}")
+        if self.neg_sharing < 1:
+            raise ValueError(
+                f"neg_sharing must be >= 1, got {self.neg_sharing}")
+        if self.block_tokens % self.neg_sharing:
+            raise ValueError(
+                f"neg_sharing {self.neg_sharing} must divide block_tokens "
+                f"{self.block_tokens}")
 
 
 # -- params -----------------------------------------------------------------
@@ -125,14 +139,20 @@ def _hs_targets(targets: jax.Array, codes: jax.Array, points: jax.Array,
     return ids, labels, mask
 
 
-def _row_step_scale(num_rows: int, row_ids, occ_weights, lr, cap):
-    """Per-row stability scale for bounded per-occurrence SGD: rows whose
+def _scale_from_count(count, lr, cap):
+    """Stability clamp from a per-row occurrence count: rows whose
     occurrence-weighted step budget lr·count exceeds ``cap`` are scaled so
     their total batch step equals the cap; all others keep exact sum
-    semantics. row_ids/occ_weights may be any matching shape."""
+    semantics."""
+    return jnp.minimum(1.0, cap / jnp.maximum(lr * count, 1e-6))
+
+
+def _row_step_scale(num_rows: int, row_ids, occ_weights, lr, cap):
+    """:func:`_scale_from_count` over a scatter-aggregated count.
+    row_ids/occ_weights may be any matching shape."""
     count = jnp.zeros(num_rows, jnp.float32).at[row_ids.reshape(-1)].add(
         occ_weights.reshape(-1).astype(jnp.float32))
-    return jnp.minimum(1.0, cap / jnp.maximum(lr * count, 1e-6))
+    return _scale_from_count(count, lr, cap)
 
 
 def _sgns_core(w_in, w_out, in_ids, in_weights, out_ids, labels, mask, lr,
@@ -289,76 +309,107 @@ def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
         active = (npairs > 0)
 
         centers_id = jnp.where(valid_tok & active, block, sentinel_in)
-        ctx_id = jnp.where(pair_mask, contexts, sentinel_out)        # (T, 2W)
-        negs_c = sampler(k_neg, (t, negatives))                      # (T, K)
-        negs_id = jnp.where(active[:, None], negs_c, sentinel_out)
+        blk_out_ids = jnp.where(valid_tok, block, sentinel_out)      # (T,)
+        # grouped negatives: one K-set serves G consecutive centers (G=1 =
+        # per-center); cuts negative row traffic G-fold and turns the
+        # negative contraction into an MXU-shaped (G, D)x(K, D) block
+        G = config.neg_sharing  # validated >= 1, divides block_tokens
+        if t % G:  # defensive: caller passed a non-config-sized block
+            log.fatal("neg_sharing %d must divide block length %d", G, t)
+        tg = t // G
+        act_g = active.reshape(tg, G)
+        negs_c = sampler(k_neg, (tg, negatives))                     # (TG, K)
+        negs_id = jnp.where(act_g.any(axis=1)[:, None], negs_c,
+                            sentinel_out)                            # (TG, K)
 
         v = w_in[centers_id]                                         # (T, D)
-        u_pos = w_out[ctx_id]                                        # (T, 2W, D)
-        u_neg = w_out[negs_id]                                       # (T, K, D)
+        # Block-local context reuse: every positive context row IS some
+        # block position's own w_out row, so ONE (T, D) gather serves all
+        # 2W offsets via vector rolls -- replacing the (T, 2W, D) HBM
+        # gather AND the 2W*T-row scatter with VPU shifts. Row-granular
+        # HBM ops run at a ~13ns/row descriptor floor (ops/pallas_rows.py),
+        # so shrinking the out side from (2W+K)*T rows to (1+K)*T rows is
+        # the dominant win (measured: 0.88 -> ~1.3 M words/s).
+        u_blk = w_out[blk_out_ids]                                   # (T, D)
+        u_neg = w_out[negs_id]                                       # (TG, K, D)
+        vg = v.reshape(tg, G, v.shape[1])                            # (TG, G, D)
 
-        s_pos = jnp.einsum("td,twd->tw", v, u_pos)                   # (T, 2W)
-        s_neg = jnp.einsum("td,tkd->tk", v, u_neg)                   # (T, K)
-        g_pos = (jax.nn.sigmoid(s_pos) - 1.0) * pm                   # (T, 2W)
-        # negatives are shared across the center's pairs → their per-pair
+        s_neg = jnp.einsum("gcd,gkd->gck", vg, u_neg)                # (TG, G, K)
+        # negatives are shared across the center's pairs -> their per-pair
         # gradients coincide; the pair-mean is just sigmoid(s)
-        g_neg = jax.nn.sigmoid(s_neg) * active[:, None]              # (T, K)
+        g_neg = jax.nn.sigmoid(s_neg) * act_g[:, :, None]            # (TG, G, K)
+
+        loss_pos = jnp.float32(0.0)
+        grad_v_pos = jnp.zeros_like(v)
+        g_out_local = jnp.zeros_like(u_blk)   # positive grads by POSITION
+        occ_ctx = jnp.zeros(t, jnp.float32)   # ctx occurrences by POSITION
+        for j in range(offsets.shape[0]):     # 2W, unrolled in-trace
+            o = int(offsets[j])
+            u_o = jnp.roll(u_blk, -o, axis=0)  # row t -> w_out[block[t+o]]
+            pmj = pm[:, j]                     # edge wraps masked by pm
+            s = jnp.sum(v * u_o, axis=1)                             # (T,)
+            g = (jax.nn.sigmoid(s) - 1.0) * pmj
+            loss_pos += jnp.sum(jax.nn.log_sigmoid(s) * pmj)
+            grad_v_pos += g[:, None] * u_o
+            # the contribution of center t lands on context POSITION t+o
+            g_out_local += jnp.roll(g[:, None] * v, o, axis=0)
+            occ_ctx += jnp.roll(pmj, o)
 
         # each of a center's npairs pairs contributes the same shared-negative
         # term, so the negative loss scales by npairs
         n_terms = pm.sum() * (1 + negatives)
-        loss = (-(jax.nn.log_sigmoid(s_pos) * pm).sum()
-                - (jax.nn.log_sigmoid(-s_neg).sum(axis=1) * npairs).sum()
+        npg = npairs.reshape(tg, G)
+        loss = (-loss_pos
+                - (jax.nn.log_sigmoid(-s_neg).sum(axis=2) * npg).sum()
                 ) / jnp.maximum(n_terms, 1.0)
 
+        # per-center shared-negative input gradient (both combine modes)
+        neg_v = jnp.einsum("gck,gkd->gcd", g_neg, u_neg).reshape(t, -1)
         if combine == "sum":
             # per-occurrence SGD: each of a center's npairs pairs contributes
             # its own positive term AND its own copy of the shared-negative
             # term (see the loss scaling above); a stability bound below
             # clamps hot rows (duplicate steps land on the same stale weights)
-            grad_v = (jnp.einsum("tw,twd->td", g_pos, u_pos)
-                      + npairs[:, None]
-                      * jnp.einsum("tk,tkd->td", g_neg, u_neg))      # (T, D)
-            grad_u_neg = jnp.einsum("tk,td,t->tkd", g_neg, v, npairs)
+            grad_v = grad_v_pos + npairs[:, None] * neg_v            # (T, D)
+            grad_u_neg = jnp.einsum("gck,gcd,gc->gkd", g_neg, vg, npg)
+            neg_occ = jnp.broadcast_to(npg.sum(axis=1)[:, None],
+                                       (tg, negatives))
         else:
             # "mean": one bounded lr-step per row per batch (collapses on
-            # long runs — see _sgns_core comment)
-            grad_v = (jnp.einsum("tw,twd->td", g_pos, u_pos)
-                      / jnp.maximum(npairs, 1.0)[:, None]
-                      + jnp.einsum("tk,tkd->td", g_neg, u_neg))      # (T, D)
-            grad_u_neg = jnp.einsum("tk,td->tkd", g_neg, v)          # (T, K, D)
-        grad_u_pos = jnp.einsum("tw,td->twd", g_pos, v)              # (T, 2W, D)
+            # long runs -- see _sgns_core comment)
+            grad_v = (grad_v_pos / jnp.maximum(npairs, 1.0)[:, None]
+                      + neg_v)                                       # (T, D)
+            grad_u_neg = jnp.einsum("gck,gcd->gkd", g_neg, vg)       # (TG, K, D)
+            neg_occ = jnp.broadcast_to(
+                act_g.sum(axis=1)[:, None], (tg, negatives))
 
-        dim = w_in.shape[1]
-        out_rows = jnp.concatenate(
-            [ctx_id.reshape(-1), negs_id.reshape(-1)])
-        out_grads = jnp.concatenate(
-            [grad_u_pos.reshape(-1, dim), grad_u_neg.reshape(-1, dim)])
-        gin, gout = grad_v, out_grads
+        # one combined out-row occurrence map; ctx occurrences arrive
+        # pre-reduced by position, so the scalar scatter is T + K*T
+        # entries instead of (2W+K)*T
+        out_count = (jnp.zeros(w_out.shape[0], jnp.float32)
+                     .at[blk_out_ids].add(occ_ctx)
+                     .at[negs_id.reshape(-1)].add(neg_occ.reshape(-1)))
         if combine == "mean":
             in_count = jnp.zeros(
                 w_in.shape[0], jnp.float32).at[centers_id].add(1.0)
-            out_count = jnp.zeros(
-                w_out.shape[0], jnp.float32).at[out_rows].add(1.0)
-            gin = gin / in_count[centers_id][:, None]
-            gout = gout / out_count[out_rows][:, None]
+            gin = grad_v / in_count[centers_id][:, None]
+            denom = jnp.maximum(out_count, 1.0)
+            g_out_local = g_out_local / denom[blk_out_ids][:, None]
+            grad_u_neg = grad_u_neg / denom[negs_id][:, :, None]
         else:
-            # stability bound: occurrence-units are pairs — npairs per center
-            # position, pm per positive out-entry, npairs per negative
-            # out-entry (matching the npairs scaling in the gradients above)
+            # stability bound: occurrence-units are pairs -- npairs per
+            # center position, pm per positive out-entry, npairs per
+            # negative out-entry (matching the gradient scaling above)
             cap = config.max_row_step
             in_scale = _row_step_scale(w_in.shape[0], centers_id, npairs,
                                        lr, cap)
-            out_occ = jnp.concatenate(
-                [pm.reshape(-1),
-                 jnp.broadcast_to(npairs[:, None],
-                                  (t, negatives)).reshape(-1)])
-            out_scale = _row_step_scale(w_out.shape[0], out_rows, out_occ,
-                                        lr, cap)
-            gin = gin * in_scale[centers_id][:, None]
-            gout = gout * out_scale[out_rows][:, None]
+            out_scale = _scale_from_count(out_count, lr, cap)
+            gin = grad_v * in_scale[centers_id][:, None]
+            g_out_local = g_out_local * out_scale[blk_out_ids][:, None]
+            grad_u_neg = grad_u_neg * out_scale[negs_id][:, :, None]
         w_in = w_in.at[centers_id].add(-lr * gin)
-        w_out = w_out.at[out_rows].add(-lr * gout)
+        w_out = (w_out.at[blk_out_ids].add(-lr * g_out_local)
+                 .at[negs_id].add(-lr * grad_u_neg))
         return {"w_in": w_in, "w_out": w_out}, loss
 
     if not jit:

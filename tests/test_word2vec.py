@@ -379,3 +379,23 @@ def test_small_blocks_still_train_pair_mode(mode, objective):
     t.train_block(block)
     moved = np.abs(t.embeddings() - init).max()
     assert moved > 1e-6, "sub-batch_pairs blocks trained nothing"
+
+
+def test_training_separates_clusters_neg_sharing():
+    """The bench's neg_sharing=8 recipe (one negative set per 8 adjacent
+    centers) must still learn: sharing correlates the noise but not the
+    signal. Worst case is exactly this tiny vocab — at bench scale (100k
+    words) the correlation is negligible."""
+    vocab = 30
+    rng = np.random.default_rng(0)
+    corpus = _synthetic_corpus(rng, vocab)
+    d = _toy_dictionary(corpus, vocab)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            mode="sg", objective="ns", lr=0.3,
+                            batch_pairs=512, sample=0.0, block_tokens=1000,
+                            neg_sharing=8)
+    trainer = DeviceTrainer(config, d)
+    blocks = [corpus[i:i + 1000] for i in range(0, len(corpus), 1000)]
+    trainer.train(blocks, epochs=10)
+    score = _cluster_score(trainer.embeddings(), vocab)
+    assert score > 0.3, f"neg_sharing=8 failed to learn: {score}"
