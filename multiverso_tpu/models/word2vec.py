@@ -263,7 +263,7 @@ def make_train_step(config: Word2VecConfig, dictionary: Dictionary,
 
 
 def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
-                          jit: bool = True):
+                          jit: bool = True, neg_table: bool = False):
     """Block-mode step: the host ships ONE int32 token block per step (pad
     with -1); window pair extraction, dynamic-window masking, negative
     sampling, and the update all happen in-jit. This minimizes host↔device
@@ -275,14 +275,14 @@ def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
     """
     if config.mode != "sg" or config.objective != "ns":
         log.fatal("block step supports sg+ns (the benchmark path)")
-    sampler = unigram_negative_sampler(dictionary.counts)
+    sampler = None if neg_table else unigram_negative_sampler(dictionary.counts)
     window = config.window
     negatives = config.negatives
     combine = config.grad_combine
     offsets = np.array([o for o in range(-window, window + 1) if o != 0],
                        dtype=np.int32)                               # (2W,)
 
-    def step(params, key, block, lr):
+    def step(params, key, block, lr, neg_slots=None, with_pairs=False):
         # Structured form: keep the (T, 2W) pair layout instead of a flat
         # pair list. The input row of a center is gathered ONCE for its 2W
         # pairs, negatives are shared per center, and gradients are
@@ -318,7 +318,16 @@ def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
             log.fatal("neg_sharing %d must divide block length %d", G, t)
         tg = t // G
         act_g = active.reshape(tg, G)
-        negs_c = sampler(k_neg, (tg, negatives))                     # (TG, K)
+        if neg_table:
+            # compact-space mode (PS fast path): negatives come from a
+            # host-built slot-alias table whose duplicates encode the
+            # unigram^0.75 marginal exactly — uniform draws over it
+            # reproduce the sampler's distribution inside the pulled pool
+            draws = jax.random.randint(k_neg, (tg, negatives), 0,
+                                       neg_slots.shape[0])
+            negs_c = neg_slots[draws]                                # (TG, K)
+        else:
+            negs_c = sampler(k_neg, (tg, negatives))                 # (TG, K)
         negs_id = jnp.where(act_g.any(axis=1)[:, None], negs_c,
                             sentinel_out)                            # (TG, K)
 
@@ -410,6 +419,8 @@ def make_block_train_step(config: Word2VecConfig, dictionary: Dictionary,
         w_in = w_in.at[centers_id].add(-lr * gin)
         w_out = (w_out.at[blk_out_ids].add(-lr * g_out_local)
                  .at[negs_id].add(-lr * grad_u_neg))
+        if with_pairs:
+            return {"w_in": w_in, "w_out": w_out}, loss, pm.sum()
         return {"w_in": w_in, "w_out": w_out}, loss
 
     if not jit:
@@ -589,7 +600,9 @@ def _train_loop(trainer, blocks, epochs: int, log_every_s: float,
             if pipelined:
                 nxt = trainer.submit_block(block, lr=lr)
                 if pending is not None:
-                    trainer.finish_block(pending)
+                    # loss stays on-device: fetching it here would put a
+                    # full host round trip between block submissions
+                    trainer.finish_block(pending, fetch_stats=False)
                 pending = nxt
             else:
                 trainer.train_block(block, lr=lr)
@@ -818,6 +831,44 @@ class PSTrainer:
         self.rng = np.random.default_rng(config.seed)
         self.words_trained = 0
         self.last_block_stats: Dict[str, int] = {}
+        # sg+ns fast path (device IO only): the roll-formulation block
+        # kernel run directly on the compact candidate space -- one
+        # training dispatch per block, an 8k-token host remap instead of a
+        # per-pair one, and a 32KB block transfer instead of MB-scale pair
+        # stacks. Negatives come from a fixed-size pool whose slot-alias
+        # table preserves the unigram^0.75 marginal (see _submit_block_fast).
+        self._fast_sgns = (config.mode == "sg" and config.objective == "ns")
+        if self._fast_sgns:
+            raw = make_block_train_step(config, dictionary, jit=False,
+                                        neg_table=True)
+            dim = config.dim
+
+            def fast_delta(cached_in, cached_out, key, blocks_c, neg_slots,
+                           lr, scale):
+                w_in = cached_in[:, :dim]
+                w_out = cached_out[:, :dim]
+
+                def body(carry, blk):
+                    params, key = carry
+                    key, sub = jax.random.split(key)
+                    params, loss, pairs = raw(params, sub, blk, lr,
+                                              neg_slots, with_pairs=True)
+                    return (params, key), (loss, pairs)
+
+                (params, _), (losses, pairs) = jax.lax.scan(
+                    body, ({"w_in": w_in, "w_out": w_out}, key), blocks_c)
+                # pair-weighted: pad chunks (0 pairs, 0 loss) contribute
+                # nothing, matching the pair path's weighted mean
+                stats = jnp.stack([(losses * pairs).sum(), pairs.sum(),
+                                   pairs.sum()])
+                return ((params["w_in"] - w_in) * scale,
+                        (params["w_out"] - w_out) * scale, stats)
+
+            self._fast_delta_fn = jax.jit(fast_delta, donate_argnums=(0, 1))
+            self._fast_key = jax.random.PRNGKey(config.seed + 1)
+            # cap on the per-block negative pool (draw volume otherwise
+            # tracks the old per-pair path: ~len(block)*window*negatives)
+            self.neg_pool = 16384
 
     # -- host-side batch shaping ---------------------------------------------
     def _block_pairs(self, block: np.ndarray):
@@ -867,6 +918,10 @@ class PSTrainer:
         if len(block) < 2:
             return None
         lr = self.config.lr if lr is None else lr
+        if (self._fast_sgns
+                and getattr(self.input_table, "supports_device_io", False)
+                and getattr(self.output_table, "supports_device_io", False)):
+            return self._submit_block_fast(block, lr)
         in_tok, in_w, predict = self._block_pairs(block)
         if len(predict) == 0:
             return None
@@ -996,21 +1051,116 @@ class PSTrainer:
                 "n_in": n_in, "n_out": n_out, "pairs": p,
                 "block_len": int(len(block))}
 
-    def finish_block(self, pend: Optional[Dict]) -> float:
+    def _submit_block_fast(self, block: np.ndarray, lr: float
+                           ) -> Optional[Dict]:
+        """sg+ns device fast path: run the roll-formulation block kernel
+        directly on the compact candidate space.
+
+        Layout: compact slot space = [unique block tokens | pool-only
+        negative ids | sentinel pads]; the SAME slot numbering indexes the
+        compact w_in and w_out buckets, so one 8k-token ``searchsorted``
+        remap serves both sides. Negatives: ``neg_pool`` draws from the
+        host unigram^0.75 sampler become a (P,) slot-alias table whose
+        duplicate entries encode the marginal exactly -- the kernel draws
+        uniform indices into it. Push ids are unique by construction
+        (pool-only ids exclude block tokens), as the row-DMA scatter
+        requires."""
+        blk_u = np.unique(block).astype(np.int32)
+        n_blk = len(blk_u)
+        # pool sized to the block's negative demand (the per-pair path drew
+        # ~pairs*K), pow2-bucketed so the kernel trace is shape-stable
+        p_draws = _next_pow2(min(
+            self.neg_pool,
+            max(1024, len(block) * self.config.window
+                * self.config.negatives)))
+        draws = self._neg_draw(self.rng, (p_draws,)).reshape(-1)
+        pool_only = np.setdiff1d(np.unique(draws), blk_u).astype(np.int32)
+        ids_out = np.concatenate([blk_u, pool_only])
+        # slot of each pool draw in the compact out space
+        pos = np.searchsorted(blk_u, draws)
+        in_blk = (pos < n_blk) & (blk_u[np.minimum(pos, n_blk - 1)] == draws)
+        slot_alias = np.where(
+            in_blk, pos,
+            n_blk + np.searchsorted(pool_only, draws)).astype(np.int32)
+
+        h_in = self.input_table.get_device_async(blk_u)
+        h_out = self.output_table.get_device_async(ids_out)
+        cached_in = self.input_table.wait_device(h_in, blk_u)
+        cached_out = self.output_table.wait_device(h_out, ids_out)
+
+        # Chunk the block INSIDE the one scan dispatch at roughly the
+        # pair path's update granularity (batch_pairs pairs ~ bp/window
+        # tokens): the max_row_step stability clamp is per kernel step, so
+        # hot rows move cap-per-chunk -- one whole-block step would clamp
+        # them chunks-fold harder and visibly slow small-vocab learning.
+        G = self.config.neg_sharing
+        chunk = _next_pow2(max(128, self.config.batch_pairs
+                               // max(self.config.window, 1)))
+        chunk = min(chunk, _next_pow2(max(len(block), G)))
+        if chunk % G:
+            chunk *= G  # keep the grouped-negatives constraint
+        n_chunks = _next_pow2(-(-len(block) // chunk))
+        blocks_c = np.full((n_chunks, chunk), -1, np.int32)
+        flat = np.searchsorted(blk_u, block).astype(np.int32)
+        blocks_c.reshape(-1)[: len(block)] = flat
+
+        self._fast_key, sub = jax.random.split(self._fast_key)
+        scale = (-1.0 / lr) if self.use_adagrad else 1.0
+        delta_in, delta_out, stats = self._fast_delta_fn(
+            cached_in, cached_out, sub, jnp.asarray(blocks_c),
+            jnp.asarray(slot_alias), lr, scale)
+
+        sentinel_i = self.input_table.sentinel_row
+        sentinel_o = self.output_table.sentinel_row
+        r_in, r_out = cached_in.shape[0], cached_out.shape[0]
+        ids_in_p = np.concatenate(
+            [blk_u, np.full(r_in - n_blk, sentinel_i, np.int32)])
+        ids_out_p = np.concatenate(
+            [ids_out, np.full(r_out - len(ids_out), sentinel_o, np.int32)])
+        if self.use_adagrad:
+            from multiverso_tpu.updaters import AddOption
+            opt = AddOption(
+                worker_id=self.input_table._channel.worker_id(),
+                learning_rate=lr)
+            a1 = self.input_table.add_device_async(delta_in, ids_in_p,
+                                                   option=opt)
+            a2 = self.output_table.add_device_async(delta_out, ids_out_p,
+                                                    option=opt)
+        else:
+            a1 = self.input_table.add_device_async(delta_in, ids_in_p)
+            a2 = self.output_table.add_device_async(delta_out, ids_out_p)
+        stats.copy_to_host_async()  # overlap the RTT with later work
+        return {"a1": a1, "a2": a2, "stats": stats, "block_len": len(block),
+                "n_in": n_blk, "n_out": len(ids_out), "pairs": -1}
+
+    def finish_block(self, pend: Optional[Dict],
+                     fetch_stats: bool = True) -> float:
+        """Reclaim a submitted block's completions. ``fetch_stats=False``
+        skips the loss materialization — on tunneled chips that scalar
+        fetch is a full ~100ms round trip serialized between block
+        submissions, and the pipelined epoch loop only needs words/sec
+        (host-side). The device stats stay retrievable via train_block's
+        default fetching path."""
         if pend is None:
             return 0.0
         # overlapped pushes; waits reclaim the completions
         self.input_table.wait(pend["a1"])
         self.output_table.wait(pend["a2"])
-        if pend["stats"] is not None:
-            loss_sum, w_sum = np.asarray(pend["stats"])
-        else:
-            loss_sum, w_sum = pend["loss_sum"], pend["w_sum"]
         self.count_table.add([0], [pend["block_len"]])
         self.words_trained += pend["block_len"]
         self.last_block_stats = {"in_rows": pend["n_in"],
                                  "out_rows": pend["n_out"],
                                  "pairs": pend["pairs"]}
+        if not fetch_stats:
+            return 0.0
+        if pend["stats"] is not None:
+            vals = np.asarray(pend["stats"])
+            loss_sum, w_sum = vals[0], vals[1]
+            if len(vals) > 2 and pend.get("pairs", -1) < 0:
+                pend["pairs"] = int(vals[2])  # fast path: counted in-jit
+                self.last_block_stats["pairs"] = pend["pairs"]
+        else:
+            loss_sum, w_sum = pend["loss_sum"], pend["w_sum"]
         return float(loss_sum) / max(float(w_sum), 1.0)
 
     def train(self, blocks, epochs: int = 1, log_every_s: float = 10.0,
