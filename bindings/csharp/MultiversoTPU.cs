@@ -1,0 +1,184 @@
+// C# P/Invoke binding for multiverso_tpu.
+//
+// Capability parity with the reference's MultiversoCLR wrapper
+// (binding/C#/MultiversoCLR/MultiversoCLR.cpp:23-115): lifecycle, identity,
+// and Array/Matrix table create/get/add over the flat C API
+// (multiverso_tpu/native/c_api.h). Where MultiversoCLR was a mixed-mode
+// C++/CLI assembly (Windows-only), this is portable P/Invoke — build with
+// `dotnet build` anywhere libmultiverso_tpu.so loads.
+//
+//   using MultiversoTPU;
+//   MV.Init();
+//   var t = new ArrayTable(1000);
+//   t.Add(delta);                       // float[1000]
+//   float[] v = t.Get();
+//   MV.ShutDown();
+//
+// The native library must be on the loader path:
+//   export LD_LIBRARY_PATH=$REPO/multiverso_tpu/native:$LD_LIBRARY_PATH
+
+using System;
+using System.Runtime.InteropServices;
+
+namespace MultiversoTPU
+{
+    public static class MV
+    {
+        const string Lib = "multiverso_tpu";
+
+        [DllImport(Lib, EntryPoint = "MV_Init")]
+        static extern void MV_Init(ref int argc, string[] argv);
+        [DllImport(Lib, EntryPoint = "MV_ShutDown")]
+        static extern void MV_ShutDown();
+        [DllImport(Lib, EntryPoint = "MV_Barrier")]
+        static extern void MV_Barrier();
+        [DllImport(Lib, EntryPoint = "MV_NumWorkers")]
+        static extern int MV_NumWorkers();
+        [DllImport(Lib, EntryPoint = "MV_NumServers")]
+        static extern int MV_NumServers();
+        [DllImport(Lib, EntryPoint = "MV_WorkerId")]
+        static extern int MV_WorkerId();
+        [DllImport(Lib, EntryPoint = "MV_ServerId")]
+        static extern int MV_ServerId();
+        [DllImport(Lib, EntryPoint = "MV_Rank")]
+        static extern int MV_Rank();
+        [DllImport(Lib, EntryPoint = "MV_Size")]
+        static extern int MV_Size();
+        [DllImport(Lib, EntryPoint = "MV_SetFlag")]
+        static extern void MV_SetFlag(string name, string value);
+
+        public static void Init(string[] args = null)
+        {
+            args = args ?? Array.Empty<string>();
+            int argc = args.Length;
+            MV_Init(ref argc, args);
+        }
+        public static void ShutDown() => MV_ShutDown();
+        public static void Barrier() => MV_Barrier();
+        public static int NumWorkers => MV_NumWorkers();
+        public static int NumServers => MV_NumServers();
+        public static int WorkerId => MV_WorkerId();
+        public static int ServerId => MV_ServerId();
+        public static int Rank => MV_Rank();
+        public static int Size => MV_Size();
+        public static void SetFlag(string name, string value) =>
+            MV_SetFlag(name, value);
+    }
+
+    public sealed class ArrayTable
+    {
+        const string Lib = "multiverso_tpu";
+
+        [DllImport(Lib, EntryPoint = "MV_NewArrayTable")]
+        static extern void MV_NewArrayTable(int size, out IntPtr handler);
+        [DllImport(Lib, EntryPoint = "MV_GetArrayTable")]
+        static extern void MV_GetArrayTable(IntPtr handler, float[] data,
+                                            int size);
+        [DllImport(Lib, EntryPoint = "MV_AddArrayTable")]
+        static extern void MV_AddArrayTable(IntPtr handler, float[] data,
+                                            int size);
+        [DllImport(Lib, EntryPoint = "MV_AddAsyncArrayTable")]
+        static extern void MV_AddAsyncArrayTable(IntPtr handler, float[] data,
+                                                 int size);
+
+        readonly IntPtr _h;
+        public int Size { get; }
+
+        public ArrayTable(int size)
+        {
+            Size = size;
+            MV_NewArrayTable(size, out _h);
+        }
+
+        public float[] Get()
+        {
+            var buf = new float[Size];
+            MV_GetArrayTable(_h, buf, Size);
+            return buf;
+        }
+
+        public void Add(float[] delta, bool sync = false)
+        {
+            if (delta.Length != Size)
+                throw new ArgumentException("delta length != table size");
+            if (sync) MV_AddArrayTable(_h, delta, Size);
+            else MV_AddAsyncArrayTable(_h, delta, Size);
+        }
+    }
+
+    public sealed class MatrixTable
+    {
+        const string Lib = "multiverso_tpu";
+
+        [DllImport(Lib, EntryPoint = "MV_NewMatrixTable")]
+        static extern void MV_NewMatrixTable(int numRow, int numCol,
+                                             out IntPtr handler);
+        [DllImport(Lib, EntryPoint = "MV_GetMatrixTableAll")]
+        static extern void MV_GetMatrixTableAll(IntPtr handler, float[] data,
+                                                int size);
+        [DllImport(Lib, EntryPoint = "MV_AddMatrixTableAll")]
+        static extern void MV_AddMatrixTableAll(IntPtr handler, float[] data,
+                                                int size);
+        [DllImport(Lib, EntryPoint = "MV_AddAsyncMatrixTableAll")]
+        static extern void MV_AddAsyncMatrixTableAll(IntPtr handler,
+                                                     float[] data, int size);
+        [DllImport(Lib, EntryPoint = "MV_GetMatrixTableByRows")]
+        static extern void MV_GetMatrixTableByRows(IntPtr handler,
+                                                   float[] data, int size,
+                                                   int[] rowIds, int rowIdsN);
+        [DllImport(Lib, EntryPoint = "MV_AddMatrixTableByRows")]
+        static extern void MV_AddMatrixTableByRows(IntPtr handler,
+                                                   float[] data, int size,
+                                                   int[] rowIds, int rowIdsN);
+        [DllImport(Lib, EntryPoint = "MV_AddAsyncMatrixTableByRows")]
+        static extern void MV_AddAsyncMatrixTableByRows(IntPtr handler,
+                                                        float[] data, int size,
+                                                        int[] rowIds,
+                                                        int rowIdsN);
+
+        readonly IntPtr _h;
+        public int NumRow { get; }
+        public int NumCol { get; }
+
+        public MatrixTable(int numRow, int numCol)
+        {
+            NumRow = numRow;
+            NumCol = numCol;
+            MV_NewMatrixTable(numRow, numCol, out _h);
+        }
+
+        public float[] Get(int[] rowIds = null)
+        {
+            if (rowIds == null)
+            {
+                var all = new float[NumRow * NumCol];
+                MV_GetMatrixTableAll(_h, all, all.Length);
+                return all;
+            }
+            var buf = new float[rowIds.Length * NumCol];
+            MV_GetMatrixTableByRows(_h, buf, buf.Length, rowIds,
+                                    rowIds.Length);
+            return buf;
+        }
+
+        public void Add(float[] delta, int[] rowIds = null, bool sync = false)
+        {
+            int expect = (rowIds == null ? NumRow : rowIds.Length) * NumCol;
+            if (delta.Length != expect)
+                throw new ArgumentException(
+                    $"delta length {delta.Length} != expected {expect}");
+            if (rowIds == null)
+            {
+                if (sync) MV_AddMatrixTableAll(_h, delta, delta.Length);
+                else MV_AddAsyncMatrixTableAll(_h, delta, delta.Length);
+                return;
+            }
+            if (sync)
+                MV_AddMatrixTableByRows(_h, delta, delta.Length, rowIds,
+                                        rowIds.Length);
+            else
+                MV_AddAsyncMatrixTableByRows(_h, delta, delta.Length, rowIds,
+                                             rowIds.Length);
+        }
+    }
+}

@@ -1,0 +1,178 @@
+--- LuaJIT FFI binding for multiverso_tpu.
+--
+-- Capability parity with the reference Torch-Lua binding
+-- (binding/lua/init.lua): init/shutdown/barrier, identity queries, and
+-- Array/Matrix table handlers over the flat C API
+-- (multiverso_tpu/native/c_api.h). Tables created here live in TPU HBM;
+-- the embedded-CPython shim behind the C ABI drives the full runtime.
+--
+-- Usage (LuaJIT; torch not required):
+--   local mv = require 'multiverso'
+--   mv.init()
+--   local tbl = mv.ArrayTableHandler:new(1000)
+--   tbl:add(torch.ones(1000))          -- or a plain Lua array
+--   local v = tbl:get()
+--   mv.shutdown()
+--
+-- The shared library must be on the loader path:
+--   export LD_LIBRARY_PATH=$REPO/multiverso_tpu/native:$LD_LIBRARY_PATH
+
+local ffi = require('ffi')
+
+ffi.cdef[[
+typedef void* TableHandler;
+void MV_Init(int* argc, char* argv[]);
+void MV_ShutDown();
+void MV_Barrier();
+int MV_NumWorkers();
+int MV_NumServers();
+int MV_WorkerId();
+int MV_ServerId();
+int MV_Rank();
+int MV_Size();
+void MV_SetFlag(const char* name, const char* value);
+void MV_NewArrayTable(int size, TableHandler* out);
+void MV_GetArrayTable(TableHandler handler, float* data, int size);
+void MV_AddArrayTable(TableHandler handler, float* data, int size);
+void MV_AddAsyncArrayTable(TableHandler handler, float* data, int size);
+void MV_NewMatrixTable(int num_row, int num_col, TableHandler* out);
+void MV_GetMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_AddAsyncMatrixTableAll(TableHandler handler, float* data, int size);
+void MV_GetMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int* row_ids, int row_ids_n);
+void MV_AddMatrixTableByRows(TableHandler handler, float* data, int size,
+                             int* row_ids, int row_ids_n);
+void MV_AddAsyncMatrixTableByRows(TableHandler handler, float* data, int size,
+                                  int* row_ids, int row_ids_n);
+]]
+
+local lib = ffi.load('multiverso_tpu')
+
+local mv = {}
+
+-- -- lifecycle --------------------------------------------------------------
+
+function mv.init(args)
+  args = args or {}
+  local argc = ffi.new('int[1]', #args)
+  local argv = ffi.new('char*[?]', #args + 1)
+  local keep = {}
+  for i, a in ipairs(args) do
+    local buf = ffi.new('char[?]', #a + 1, a)
+    keep[i] = buf
+    argv[i - 1] = buf
+  end
+  mv._argv_keep = keep  -- anchor against GC for the duration of the call
+  lib.MV_Init(argc, argv)
+  mv._argv_keep = nil
+end
+
+function mv.shutdown() lib.MV_ShutDown() end
+function mv.barrier() lib.MV_Barrier() end
+function mv.num_workers() return lib.MV_NumWorkers() end
+function mv.num_servers() return lib.MV_NumServers() end
+function mv.worker_id() return lib.MV_WorkerId() end
+function mv.server_id() return lib.MV_ServerId() end
+function mv.rank() return lib.MV_Rank() end
+function mv.size() return lib.MV_Size() end
+function mv.set_flag(name, value) lib.MV_SetFlag(name, tostring(value)) end
+
+-- -- helpers ----------------------------------------------------------------
+
+-- Accepts a torch FloatTensor (duck-typed via :data()/:nElement()), a Lua
+-- array of numbers, or a ffi float buffer; returns (float*, n, anchor).
+local function as_floats(x, n)
+  if type(x) == 'cdata' then return x, n, x end
+  if type(x) == 'table' then
+    local buf = ffi.new('float[?]', #x, x)
+    return buf, #x, buf
+  end
+  -- torch-like tensor
+  return x:data(), x:nElement(), x
+end
+
+local function to_table(buf, n)
+  local out = {}
+  for i = 1, n do out[i] = buf[i - 1] end
+  return out
+end
+
+-- -- array table ------------------------------------------------------------
+
+local ArrayTableHandler = {}
+ArrayTableHandler.__index = ArrayTableHandler
+mv.ArrayTableHandler = ArrayTableHandler
+
+function ArrayTableHandler:new(size)
+  local self = setmetatable({ size = size }, ArrayTableHandler)
+  local out = ffi.new('TableHandler[1]')
+  lib.MV_NewArrayTable(size, out)
+  self._h = out[0]
+  return self
+end
+
+function ArrayTableHandler:get()
+  local buf = ffi.new('float[?]', self.size)
+  lib.MV_GetArrayTable(self._h, buf, self.size)
+  return to_table(buf, self.size)
+end
+
+function ArrayTableHandler:add(delta, opts)
+  local buf, n = as_floats(delta, self.size)
+  if opts and opts.sync then
+    lib.MV_AddArrayTable(self._h, buf, n)
+  else
+    lib.MV_AddAsyncArrayTable(self._h, buf, n)
+  end
+end
+
+-- -- matrix table -----------------------------------------------------------
+
+local MatrixTableHandler = {}
+MatrixTableHandler.__index = MatrixTableHandler
+mv.MatrixTableHandler = MatrixTableHandler
+
+function MatrixTableHandler:new(num_row, num_col)
+  local self = setmetatable(
+    { num_row = num_row, num_col = num_col }, MatrixTableHandler)
+  local out = ffi.new('TableHandler[1]')
+  lib.MV_NewMatrixTable(num_row, num_col, out)
+  self._h = out[0]
+  return self
+end
+
+function MatrixTableHandler:get(row_ids)
+  if row_ids == nil then
+    local n = self.num_row * self.num_col
+    local buf = ffi.new('float[?]', n)
+    lib.MV_GetMatrixTableAll(self._h, buf, n)
+    return to_table(buf, n)
+  end
+  local ids = ffi.new('int[?]', #row_ids, row_ids)
+  local n = #row_ids * self.num_col
+  local buf = ffi.new('float[?]', n)
+  lib.MV_GetMatrixTableByRows(self._h, buf, n, ids, #row_ids)
+  return to_table(buf, n)
+end
+
+function MatrixTableHandler:add(delta, row_ids, opts)
+  if row_ids == nil then
+    local buf, n = as_floats(delta, self.num_row * self.num_col)
+    if opts and opts.sync then
+      lib.MV_AddMatrixTableAll(self._h, buf, n)
+    else
+      lib.MV_AddAsyncMatrixTableAll(self._h, buf, n)
+    end
+    return
+  end
+  local ids = ffi.new('int[?]', #row_ids, row_ids)
+  local buf, n = as_floats(delta, #row_ids * self.num_col)
+  if opts and opts.sync then
+    lib.MV_AddMatrixTableByRows(self._h, buf, n, ids, #row_ids)
+  else
+    lib.MV_AddAsyncMatrixTableByRows(self._h, buf, n, ids, #row_ids)
+  end
+end
+
+return mv

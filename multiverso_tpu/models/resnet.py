@@ -222,20 +222,24 @@ class ASGDTrainer:
         self.step_fn = make_train_step(self.model, config)
         self._state0 = train_state(self.model, config, variables)
         self.final_state = None
+        # ONE manager (one table) for the trainer's lifetime, created here
+        # so CheckpointDriver([trainer.manager.table], ...) can be set up
+        # BEFORE train() runs (periodic mid-training snapshots)
+        from multiverso_tpu.ext import PytreeParamManager
+        self.manager = PytreeParamManager(self._state0["params"])
 
     def train(self, images: np.ndarray, labels: np.ndarray, epochs: int = 1,
               batch: int = 128, lr: Optional[float] = None) -> dict:
         """Shard the data across workers, run ASGD, return the final state
         with the merged global params from the table."""
-        from multiverso_tpu.ext import PytreeParamManager
         import threading
 
         mv, cfg = self.mv, self.config
         lr = cfg.lr if lr is None else lr
         shard = len(images) // self.workers
-        # ONE manager (one table) created up front; each worker thread gets
-        # its own view with a private delta baseline
-        manager = PytreeParamManager(self._state0["params"])
+        # each worker thread gets its own view of the shared manager table,
+        # with a private delta baseline
+        manager = self.manager
         results = [None] * self.workers
 
         def work(slot: int):

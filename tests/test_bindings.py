@@ -1,0 +1,76 @@
+"""Binding-contract tests: the Lua and C# bindings are FFI declarations
+over libmultiverso_tpu.so — a symbol they name that the library doesn't
+export fails silently at their runtime (which this image can't host), so
+CI enforces the contract here instead (see bindings/README.md)."""
+
+import ctypes
+import re
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+NATIVE = REPO / "multiverso_tpu" / "native"
+SO = NATIVE / "libmultiverso_tpu.so"
+
+
+def _build_native():
+    if not SO.exists():
+        subprocess.run(["make", "-C", str(NATIVE)], check=True,
+                       capture_output=True)
+    return ctypes.CDLL(str(SO))
+
+
+def _header_symbols():
+    hdr = (NATIVE / "c_api.h").read_text()
+    return set(re.findall(r"\b(MV_\w+)\s*\(", hdr))
+
+
+def test_lua_binding_symbols_resolve():
+    lib = _build_native()
+    lua = (REPO / "bindings" / "lua" / "multiverso.lua").read_text()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", lua, re.S).group(1)
+    declared = set(re.findall(r"\b(MV_\w+)\s*\(", cdef))
+    assert declared, "no symbols declared in the Lua cdef"
+    for sym in sorted(declared):
+        assert hasattr(lib, sym), f"Lua binding declares {sym}: not exported"
+    # the cdef must not silently omit part of the C API surface
+    assert declared == _header_symbols()
+    # every declared function is actually wrapped in the Lua module body
+    body = lua.split("]]", 1)[1]
+    for sym in sorted(declared):
+        assert f"lib.{sym}(" in body, f"{sym} declared but never called"
+
+
+def test_csharp_binding_symbols_resolve():
+    lib = _build_native()
+    cs = (REPO / "bindings" / "csharp" / "MultiversoTPU.cs").read_text()
+    declared = set(re.findall(r'EntryPoint = "(MV_\w+)"', cs))
+    assert declared, "no DllImport entry points in the C# binding"
+    for sym in sorted(declared):
+        assert hasattr(lib, sym), f"C# binding imports {sym}: not exported"
+    assert declared == _header_symbols()
+
+
+def test_lua_cdef_matches_header_signatures():
+    """The Lua cdef must be a verbatim re-declaration of the header's
+    prototypes (whitespace-normalized): a drifted signature corrupts the
+    FFI call ABI without any load-time error."""
+    lua = (REPO / "bindings" / "lua" / "multiverso.lua").read_text()
+    cdef = re.search(r"ffi\.cdef\[\[(.*?)\]\]", lua, re.S).group(1)
+    hdr = (NATIVE / "c_api.h").read_text()
+
+    def protos(text):
+        out = {}
+        for m in re.finditer(
+                r"([\w][\w\s]*?\**\s*)(MV_\w+)\s*\(([^)]*)\)", text, re.S):
+            norm = re.sub(r"\s+", " ", f"{m.group(1)} {m.group(3)}").strip()
+            out[m.group(2)] = norm
+        return out
+
+    hp = protos(hdr)
+    # the parser itself must cover the full surface, or drifted signatures
+    # for unparsed return types would silently escape verification
+    assert set(hp) == _header_symbols()
+    assert protos(cdef) == hp
