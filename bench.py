@@ -345,12 +345,16 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
                 jax.device_put(jnp.asarray(y[i:i + batch])))
                for i in range(0, len(X) - batch + 1, batch)]
 
-    def run(n, state, view=None):
+    def run(n, state, view=None, pipeline=False):
         for i in range(n):
             xb, yb = batches[i % len(batches)]
             state, _ = step(state, xb, yb, cfg.lr)
             if view is not None:
-                state["params"] = view.sync(state["params"])
+                state["params"] = (view.sync_pipelined(state["params"])
+                                   if pipeline
+                                   else view.sync(state["params"]))
+        if view is not None and pipeline:
+            state["params"] = view.drain()
         _fetch(jax.tree.leaves(state["params"])[0])
         return state
 
@@ -362,7 +366,7 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
         # interleaved min-of-3 rounds per variant: shared-tunnel load
         # bursts last seconds, and a burst landing on one single-shot
         # measurement otherwise fabricates the overhead ratio
-        t_plain = t_sync = float("inf")
+        t_plain = t_sync = t_pipe = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
             state = run(steps, state)
@@ -370,6 +374,9 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
             t0 = time.perf_counter()
             state = run(steps, state, view)
             t_sync = min(t_sync, (time.perf_counter() - t0) / steps)
+            t0 = time.perf_counter()
+            state = run(steps, state, view, pipeline=True)
+            t_pipe = min(t_pipe, (time.perf_counter() - t0) / steps)
     finally:
         mv.shutdown()
     return {
@@ -380,6 +387,11 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
         # +10.8% overhead row was ~140ms/batch absolute on 1.3s steps;
         # here the tunnel's per-dispatch submission dominates)
         "asgd_sync_ms": round(1e3 * (t_sync - t_plain), 2),
+        # one-round-stale pipelined sync (sync_pipelined): the submission
+        # overlaps the next batch's compute — the reference LR pipeline's
+        # double-buffer shape applied to ASGD
+        "asgd_pipelined_overhead_pct": round(
+            100.0 * (t_pipe - t_plain) / t_plain, 1),
     }
 
 

@@ -40,11 +40,19 @@ class ParamManager:
         # master-only Add into a zero table: shard-consistent under
         # multi-process SPMD (see sharedvar.py seeding note)
         self._table = mv.create_table("array", flat.size, np.float32)
-        if mv.is_master_worker():
-            self._table.add(flat)
         from multiverso_tpu.runtime.zoo import Zoo
-        Zoo.instance().process_barrier()
-        self._last_synced = self._table.get()
+        zoo = Zoo.instance()
+        # setup traffic is administrative: seeding must not be charged to a
+        # worker's round budget (under BSP an unbound thread defaults to
+        # slot 0 and its gated Get would wedge before rounds ever start).
+        # Master-ness is decided BEFORE entering admin (inside, the thread
+        # has no worker identity at all).
+        is_master = mv.is_master_worker()
+        with zoo.admin():
+            if is_master:
+                self._table.add(flat)
+            zoo.process_barrier()
+            self._last_synced = self._table.get()
         self._set_from_flat(self._last_synced)
 
     # -- subclass surface ---------------------------------------------------
@@ -145,12 +153,19 @@ class PytreeWorkerSync:
 
     def __init__(self, manager: "PytreeParamManager",
                  device: bool = False) -> None:
+        from multiverso_tpu.runtime.zoo import Zoo
         self._jax = manager._jax
         self._treedef = manager._treedef
         self._shapes = manager._shapes
         self._dtypes = manager._dtypes
         self._sizes = manager._sizes
         self._table = manager.table
+        self._zoo = Zoo.instance()
+        # pipelined-sync state (sync_pipelined/drain): the outstanding
+        # push's handle, and the baseline matching what the caller is
+        # currently computing FROM (one reply behind _last)
+        self._inflight = None
+        self._last_handed = None
         self._device = bool(device) and getattr(
             self._table, "supports_device_io", False)
         if self._device:
@@ -159,23 +174,21 @@ class PytreeWorkerSync:
             import jax.numpy as jnp_mod
 
             @jax.jit
-            def delta_fn(new, last):
-                return [n - l for n, l in zip(new, last)]
-
-            @jax.jit
             def copy_fn(ls):
                 return [jnp_mod.copy(x) for x in ls]
 
-            self._delta_fn, self._copy_fn = delta_fn, copy_fn
+            self._copy_fn = copy_fn
             # _last is a list of SINGLE-DEVICE leaves (the server's leaf
             # codec commits them): worker-thread math on them never runs
             # cross-shard collectives, which must stay on the dispatcher
             template = [jax.numpy.zeros(s, d)
                         for s, d in zip(self._shapes, self._dtypes)]
-            self._last = self._table.wait(
-                self._table.get_leaves_async(template))
+            with self._zoo.admin():  # setup read: un-clocked
+                self._last = self._table.wait(
+                    self._table.get_leaves_async(template))
         else:
-            self._last = self._table.get()
+            with self._zoo.admin():
+                self._last = self._table.get()
 
     def _unflatten(self, flat) -> Any:
         if self._device:
@@ -192,6 +205,9 @@ class PytreeWorkerSync:
 
     @property
     def params(self) -> Any:
+        if self._inflight is not None:
+            mv.log.fatal("a pipelined sync is outstanding; call drain() "
+                         "before reading params")
         if self._device:  # hand out copies; callers may donate them
             return self._unflatten(self._copy_fn(self._last))
         return self._unflatten(self._last)
@@ -201,18 +217,41 @@ class PytreeWorkerSync:
         if treedef != self._treedef:
             mv.log.fatal("pytree structure changed across sync")
         if self._device:
-            # HBM end-to-end, single hop: one jitted (single-device) delta
-            # on the worker thread, then the server's fused leaf sync —
-            # flatten, update, and split all on the dispatcher thread
-            delta = self._delta_fn(leaves, self._last)
-            merged = self._table.wait(self._table.sync_leaves_async(delta))
-            if merged is None:  # deferred-apply server (BSP/deterministic)
+            last = self._last
+            if self._inflight is not None:
+                # mixing after sync_pipelined: consume the outstanding
+                # reply, but the delta base for THIS push must stay the
+                # value the caller computed FROM (_last_handed) — rebasing
+                # onto the drained merged value would subtract peers'
+                # (and our own in-flight) work from the delta
+                self._table.wait(self._inflight)
+                self._inflight = None
+                last = self._last_handed
+                self._last_handed = None
+            server = self._zoo.server
+            if (getattr(server, "gates_gets", False)
+                    or getattr(server, "defers_adds", False)):
+                # BSP (fused reply samples at apply time — cannot honor
+                # the round-gated Get contract) or deferred-apply
+                # (deterministic: fused reply would be None): reply-free
+                # pair push, then a properly gated/ordered get
+                self._table.wait(
+                    self._table.push_leaves_async(leaves, last))
                 merged = self._table.wait(
-                    self._table.get_leaves_async(delta))
-            # baseline keeps its OWN buffers: the caller typically feeds
-            # the returned tree into a donating train step, which would
-            # delete a shared _last out from under the next delta
-            self._last = self._copy_fn(merged)
+                    self._table.get_leaves_async(leaves))
+                # baseline keeps its OWN buffers: the caller typically
+                # feeds the returned tree into a donating train step,
+                # which would delete a shared _last out from under the
+                # next delta
+                self._last = self._copy_fn(merged)
+                return self._unflatten(merged)
+            # HBM end-to-end, ONE device dispatch for the whole sync: the
+            # server computes new-last, applies the update, and replies
+            # (merged, baseline) from a single fused jit — dispatch
+            # submission is the dominant cost on tunneled TPUs (~2.5-4 ms
+            # each), and this path submits exactly one
+            merged, self._last = self._table.wait(
+                self._table.sync_leaves_async(leaves, last_leaves=last))
             return self._unflatten(merged)
         flat = np.concatenate(
             [np.asarray(l, dtype=np.float32).reshape(-1) for l in leaves]
@@ -220,6 +259,61 @@ class PytreeWorkerSync:
         self._table.add(flat - self._last)
         self._last = self._table.get()
         return self._unflatten(self._last)
+
+    def sync_pipelined(self, tree: Any) -> Any:
+        """One-round-stale sync that never blocks on the server: submits
+        this round's push and returns the PREVIOUS round's merged value
+        (the reference's double-buffer prefetch shape,
+        ``ps_model.cpp:236-271``, applied to ASGD). The returned tree is
+        one round stale; the local delta is never lost — it is in flight.
+
+        Delta bookkeeping needs TWO baselines: the push's ``last`` must be
+        the value the worker actually computed FROM (the tree handed out
+        two calls ago), not the latest merged value — using the latest
+        would subtract the worker's own in-flight push from its next
+        delta. Falls back to blocking :meth:`sync` on servers that gate
+        or defer (BSP/deterministic), where rounds cannot overlap."""
+        server = self._zoo.server
+        if (not self._device or getattr(server, "gates_gets", False)
+                or getattr(server, "defers_adds", False)):
+            return self.sync(tree)
+        leaves, treedef = self._jax.tree_util.tree_flatten(tree)
+        if treedef != self._treedef:
+            mv.log.fatal("pytree structure changed across sync")
+        handed = self._last_handed
+        first = handed is None
+        merged_prev = baseline_prev = None
+        if first:
+            handed = self._last  # view init value: the caller's start point
+            # first call hands back the init value; the push is in flight.
+            # Two SEPARATE copies (merged_prev gets donated by the caller's
+            # train step; baseline_prev must survive as the next push's
+            # donated last_leaves), submitted BEFORE the push so they read
+            # `handed` ahead of the fused sync donating it.
+            merged_prev = self._copy_fn(handed)
+            baseline_prev = self._copy_fn(handed)
+            self._last = None  # donated by the push below
+        handle = self._table.sync_leaves_async(leaves, last_leaves=handed)
+        if not first:
+            # the async Server never replies None (gated/deferred servers
+            # were routed to sync() above and cannot change mid-run)
+            merged_prev, baseline_prev = self._table.wait(self._inflight)
+        self._inflight = handle
+        self._last_handed = baseline_prev
+        return self._unflatten(merged_prev)
+
+    def drain(self) -> Any:
+        """Complete an outstanding :meth:`sync_pipelined` push and return
+        the up-to-date merged tree (call once after the training loop)."""
+        inflight = self._inflight
+        if inflight is None:
+            return self.params
+        # sync_pipelined only leaves _inflight set on the plain async
+        # Server, whose pair-sync reply is never None
+        merged, self._last = self._table.wait(inflight)
+        self._inflight = None
+        self._last_handed = None
+        return self._unflatten(merged)
 
 
 class TorchParamManager(ParamManager):

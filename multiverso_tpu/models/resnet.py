@@ -210,12 +210,17 @@ class ASGDTrainer:
     stats, if any, stay local (per-process there as well)."""
 
     def __init__(self, config: ResNetConfig, workers: int = 4,
-                 sync_freq: int = 1, input_shape=(32, 32, 3)) -> None:
+                 sync_freq: int = 1, input_shape=(32, 32, 3),
+                 pipeline: bool = False) -> None:
         import multiverso_tpu as mv
         self.mv = mv
         self.config = config
         self.workers = workers
         self.sync_freq = sync_freq
+        # pipeline=True: per-batch syncs use the one-round-stale
+        # sync_pipelined path (the reference LR pipeline's double-buffer
+        # shape) — the sync submission overlaps the next batch's compute
+        self.pipeline = bool(pipeline)
         rng = jax.random.PRNGKey(0)
         self.model, variables = init_resnet(
             config, rng, (1,) + tuple(input_shape))
@@ -265,7 +270,10 @@ class ASGDTrainer:
                                                 jnp.asarray(ys[idx]), lr)
                         n_batches += 1
                         if n_batches % self.sync_freq == 0:
-                            state["params"] = view.sync(state["params"])
+                            state["params"] = (
+                                view.sync_pipelined(state["params"])
+                                if self.pipeline
+                                else view.sync(state["params"]))
                 state["params"] = view.sync(state["params"])
                 results[slot] = state
 

@@ -370,7 +370,11 @@ class _RemoteArrayWorker(ArrayWorker):
         log.fatal("device IO is in-process only; remote tables use "
                   "add/add_async (host arrays)")
 
-    def sync_leaves_async(self, delta_leaves, option=None):
+    def sync_leaves_async(self, delta_leaves, option=None, last_leaves=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "add/add_async (host arrays)")
+
+    def push_leaves_async(self, new_leaves, last_leaves, option=None):
         log.fatal("device IO is in-process only; remote tables use "
                   "add/add_async (host arrays)")
 

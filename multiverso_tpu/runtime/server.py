@@ -37,6 +37,16 @@ class Server:
     happens on the dispatcher thread, overlapping the caller's compute.
     """
 
+    # True on servers that defer Gets behind round clocks (BSP): fused
+    # add+get replies sample the table AT APPLY TIME, which cannot honor a
+    # round-gated Get contract — clients (PytreeWorkerSync) check this and
+    # re-issue a properly gated Get instead of trusting the fused reply.
+    gates_gets = False
+    # True on servers that complete Adds at enqueue and apply later
+    # (deterministic ordering): fused add+get replies are None — clients
+    # should send reply-free pushes and pull separately.
+    defers_adds = False
+
     def __init__(self, num_workers: int) -> None:
         self.num_workers = num_workers
         self._tables: Dict[int, "object"] = {}  # table_id -> ServerTable
@@ -129,6 +139,8 @@ class DeterministicServer(Server):
     surface in the log, not in the caller (again like ``add_async``).
     """
 
+    defers_adds = True
+
     def __init__(self, num_workers: int) -> None:
         super().__init__(num_workers)
         self._add_queues: Dict[int, List[List[Message]]] = {}
@@ -172,6 +184,8 @@ class DeterministicServer(Server):
 class SyncServer(Server):
     """BSP dispatcher preserving the reference SyncServer's observable
     contract with per-worker vector clocks and deferred request caches."""
+
+    gates_gets = True
 
     def __init__(self, num_workers: int) -> None:
         super().__init__(num_workers)

@@ -22,6 +22,7 @@ workers, matching the reference's worker/server process split.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -180,6 +181,8 @@ class Zoo:
         if not self.node.is_worker:
             return -1
         local = getattr(_thread_local, "worker_slot", 0)
+        if local < 0:  # admin context (see admin())
+            return -1
         return self.rank * self._local_workers + local
 
     def bind_worker(self, local_slot: int) -> None:
@@ -187,6 +190,25 @@ class Zoo:
             log.fatal("bind_worker: slot %d out of range [0,%d)", local_slot,
                       self._local_workers)
         _thread_local.worker_slot = local_slot
+
+    @contextlib.contextmanager
+    def admin(self):
+        """Administrative (un-clocked) table access for the calling thread:
+        ``current_worker_id()`` reports -1 inside, so consistency servers
+        (BSP/deterministic) bypass their round clocks. For setup/teardown
+        traffic — seeding a table before training rounds start, checkpoint
+        reads — which must not be charged to a worker's round budget (an
+        unbound thread otherwise defaults to slot 0 and wedges the BSP
+        gate)."""
+        prev = getattr(_thread_local, "worker_slot", None)
+        _thread_local.worker_slot = -1
+        try:
+            yield
+        finally:
+            if prev is None:
+                del _thread_local.worker_slot
+            else:
+                _thread_local.worker_slot = prev
 
     def worker_id_to_rank(self, worker_id: int) -> int:
         return worker_id // self._local_workers

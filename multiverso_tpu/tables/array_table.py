@@ -80,6 +80,24 @@ class ArrayServer(ServerTable):
 
         self._update = _make_whole_update(self.updater)
         self._codecs: Dict = {}  # leaf-signature -> (to_flat, from_flat)
+        # (scalars tuple, worker) -> device constants. Every add would
+        # otherwise pay two host->device transfers for a 4-float envelope
+        # and a worker index — measurable against the per-dispatch floor
+        # on tunneled TPUs (the ASGD hot path sends identical envelopes
+        # every sync)
+        self._opt_cache: Dict = {}
+
+    def _option_consts(self, option: "AddOption"):
+        key = (option.scalars(), int(option.worker_id))
+        cached = self._opt_cache.get(key)
+        if cached is None:
+            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
+            worker = jnp.int32(max(option.worker_id, 0)
+                               % max(1, self.num_workers))
+            cached = (worker, scalars)
+            if len(self._opt_cache) < 4096:  # bound pathological churn
+                self._opt_cache[key] = cached
+        return cached
 
     # -- server ops --------------------------------------------------------
     def _leaf_codec(self, leaves):
@@ -132,7 +150,7 @@ class ArrayServer(ServerTable):
             leaves = split(flat)
             return jax.device_put(leaves, dev) if multi else leaves
 
-        fused = None
+        fused = fused_sync = None
         if not multi:
             # single-device mesh: the whole sync — flatten, update,
             # access, split — is ONE compiled dispatch (mixed device sets
@@ -147,23 +165,106 @@ class ArrayServer(ServerTable):
 
             fused = jax.jit(fused_impl, donate_argnums=(0, 1))
 
-        codec = (to_flat, from_flat, fused)
+            def fused_sync_impl(data, states, new_ls, last_ls, worker,
+                                scalars):
+                # delta computed HERE (not in a worker-thread jit): on a
+                # tunneled TPU each dispatch submission costs ~2.5-4 ms,
+                # so the whole ASGD sync — delta, update, access, split,
+                # baseline copy — must be ONE dispatch (measured: 3
+                # dispatches = 9.1 ms/sync vs a ~3 ms floor)
+                delta = to_flat_impl(new_ls) - to_flat_impl(last_ls)
+                data, states = update_raw(data, states, delta, worker,
+                                          scalars)
+                merged = split_impl(access(data))
+                # the baseline is a DISTINCT buffer set: callers donate the
+                # merged leaves into their train step, which would delete a
+                # shared baseline out from under the next delta
+                baseline = [jnp.copy(x) for x in merged]
+                return data, states, merged, baseline
+
+            # donate last_ls too (argnum 3): the view owns those buffers
+            # exclusively and replaces them with `baseline` on return
+            fused_sync = jax.jit(fused_sync_impl, donate_argnums=(0, 1, 3))
+
+            def fused_push_impl(data, states, new_ls, last_ls, worker,
+                                scalars):
+                # reply-free pair push for round-gated/deferred servers:
+                # no merged split, no baseline copy — the client pulls
+                # through a properly gated Get instead
+                delta = to_flat_impl(new_ls) - to_flat_impl(last_ls)
+                return update_raw(data, states, delta, worker, scalars)
+
+            fused_push = jax.jit(fused_push_impl, donate_argnums=(0, 1, 3))
+        else:
+            fused_push = None
+
+        def pair_delta_impl(new_ls, last_ls):
+            return to_flat_impl(new_ls) - to_flat_impl(last_ls)
+
+        pair_delta = jax.jit(pair_delta_impl)
+        # distinct-buffer device-local copy (staged multi-device path):
+        # far cheaper than a second split + cross-device gather
+        copy_leaves = jax.jit(lambda ls: [jnp.copy(x) for x in ls])
+
+        codec = (to_flat, from_flat, fused, fused_sync, pair_delta,
+                 fused_push, copy_leaves)
         self._codecs[key] = codec
         return codec
 
     def process_add(self, request) -> Optional[list]:
         want_get = False
-        leaf_mode = isinstance(request[0], str) and request[0] == "leaves"
-        if leaf_mode:
+        kind = request[0] if isinstance(request[0], str) else None
+        if kind == "leaves_sync":
+            # one-dispatch whole-model sync: (new, last) leaf lists in,
+            # (merged, baseline) out — see fused_sync_impl in _leaf_codec
+            _, new_ls, last_ls, option = request
+            option = option or AddOption()
+            (_, from_flat, _, fused_sync, pair_delta, _,
+             copy_leaves) = self._leaf_codec(list(new_ls))
+            worker, scalars = self._option_consts(option)
+            if fused_sync is not None:  # single-device: one dispatch
+                self.data, self.states, merged, baseline = fused_sync(
+                    self.data, self.states, list(new_ls), list(last_ls),
+                    worker, scalars)
+                return (merged, baseline)
+            # staged multi-device path: jitted pair-delta, scatter to the
+            # table sharding, one from_flat gather, then a device-local
+            # copy for the distinct baseline buffer set
+            delta = jax.device_put(
+                pair_delta(list(new_ls), list(last_ls)),
+                mesh_lib.table_sharding(self.mesh, ndim=1))
+            self.data, self.states = self._update(self.data, self.states,
+                                                  delta, worker, scalars)
+            merged = from_flat(self.updater.access(self.data))
+            return (merged, copy_leaves(merged))
+        if kind == "leaves_push":
+            # reply-free pair push (round-gated/deferred servers): apply
+            # new-last, materialize nothing — the client follows with a
+            # properly gated Get
+            _, new_ls, last_ls, option = request
+            option = option or AddOption()
+            _, _, _, _, pair_delta, fused_push, _ = self._leaf_codec(
+                list(new_ls))
+            worker, scalars = self._option_consts(option)
+            if fused_push is not None:  # single-device: one dispatch
+                self.data, self.states = fused_push(
+                    self.data, self.states, list(new_ls), list(last_ls),
+                    worker, scalars)
+                return None
+            delta = jax.device_put(
+                pair_delta(list(new_ls), list(last_ls)),
+                mesh_lib.table_sharding(self.mesh, ndim=1))
+            self.data, self.states = self._update(self.data, self.states,
+                                                  delta, worker, scalars)
+            return None
+        if kind == "leaves":
             # fused whole-model sync: delta arrives as the caller's leaf
             # list, the merged value returns the same way — one hop, all
             # sharded math right here on the dispatcher thread
             _, leaves, option = request
             option = option or AddOption()
-            to_flat, from_flat, fused = self._leaf_codec(leaves)
-            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
-            worker = jnp.int32(max(option.worker_id, 0)
-                               % max(1, self.num_workers))
+            to_flat, from_flat, fused, _, _, _, _ = self._leaf_codec(leaves)
+            worker, scalars = self._option_consts(option)
             if fused is not None:  # single-device: one compiled dispatch
                 self.data, self.states, out = fused(
                     self.data, self.states, list(leaves), worker, scalars)
@@ -192,9 +293,8 @@ class ArrayServer(ServerTable):
                       delta.size, self.size)
         if self.padded != self.size:
             delta = jnp.pad(delta, (0, self.padded - self.size))
-        scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
         # administrative access (worker id -1) charges slot 0, not slot n-1
-        worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
+        worker, scalars = self._option_consts(option)
         self.data, self.states = self._update(self.data, self.states,
                                               delta, worker, scalars)
         if want_get:
@@ -217,7 +317,7 @@ class ArrayServer(ServerTable):
                 # leaf-shaped device get: reply mirrors the template's
                 # shapes/dtypes, committed single-device (see _leaf_codec)
                 _, template, _option = request
-                _, from_flat, _ = self._leaf_codec(template)
+                _, from_flat, _, _, _, _, _ = self._leaf_codec(template)
                 return from_flat(self.updater.access(self.data))
             request, device_out = request  # in-process device-out form
         if device_out:
@@ -308,15 +408,35 @@ class ArrayWorker(WorkerTable):
         return super().add_async((delta, option, True))
 
     def sync_leaves_async(self, delta_leaves: list,
-                          option: Optional[AddOption] = None) -> int:
+                          option: Optional[AddOption] = None,
+                          last_leaves: Optional[list] = None) -> int:
         """Fused whole-model sync in the caller's own leaf shapes: ONE
         dispatcher hop; the reply is the merged value as a list of
         SINGLE-DEVICE arrays (safe for concurrent worker-thread compute —
         see ``ArrayServer._leaf_codec``). The leaf sizes must total the
         table size. Deferred-apply servers reply None; fall back to
-        ``get_leaves_async``."""
+        ``get_leaves_async``.
+
+        With ``last_leaves``, ``delta_leaves`` is instead the NEW value and
+        the server computes ``new - last`` in the same dispatch, replying
+        ``(merged, baseline)`` where ``baseline`` is a distinct buffer set
+        the caller may keep while donating ``merged``. ``last_leaves`` is
+        donated — the caller must own those buffers exclusively."""
         option = self._default_option(option)
+        if last_leaves is not None:
+            return super().add_async(("leaves_sync", list(delta_leaves),
+                                      list(last_leaves), option))
         return super().add_async(("leaves", list(delta_leaves), option))
+
+    def push_leaves_async(self, new_leaves: list, last_leaves: list,
+                          option: Optional[AddOption] = None) -> int:
+        """Reply-free pair push: the server applies ``new - last`` and
+        materializes nothing. For round-gated/deferred servers, where a
+        fused merged reply would be discarded anyway — follow with a
+        (gated) ``get_leaves_async``. ``last_leaves`` is donated."""
+        option = self._default_option(option)
+        return super().add_async(("leaves_push", list(new_leaves),
+                                  list(last_leaves), option))
 
     def get_leaves_async(self, template_leaves: list,
                          option: Optional[GetOption] = None) -> int:

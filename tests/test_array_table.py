@@ -170,3 +170,180 @@ def test_device_worker_view_matches_host_view(mv_env):
     # dev's pull must observe host's push exactly
     np.testing.assert_allclose(np.asarray(d["a"]), np.asarray(h["a"]))
     np.testing.assert_allclose(np.asarray(d["b"]), np.asarray(h["b"]))
+
+
+def test_device_sync_baseline_survives_donation(mv_env):
+    """The one-dispatch pair sync replies (merged, baseline) from a single
+    jit. `baseline` must be a DISTINCT buffer set: callers donate the
+    merged leaves into their train step, and an aliased baseline would be
+    deleted out from under the next delta."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    tree = {"w": jnp.zeros(8, jnp.float32)}
+    pm = PytreeParamManager(tree)
+    view = pm.worker_view(device=True)
+
+    consume = jax.jit(lambda t: jax.tree.map(lambda x: x * 0, t),
+                      donate_argnums=0)
+    t = {"w": jnp.full(8, 1.0, jnp.float32)}
+    for i in range(1, 4):
+        merged = view.sync(t)
+        np.testing.assert_allclose(np.asarray(merged["w"]), np.full(8, 1.0))
+        # donate the merged tree, then build the next value FROM the
+        # baseline the view kept: merged+0 means the next delta is zero
+        t = jax.tree.map(lambda x: x + 0, view.params)
+        consume(merged)
+
+
+def test_device_sync_two_views_accumulate(mv_env):
+    """Two device views over one table: each pushes its own delta; the
+    merged value sums both (the ASGD topology)."""
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    pm = PytreeParamManager({"w": jnp.zeros(4, jnp.float32)})
+    va = pm.worker_view(device=True)
+    vb = pm.worker_view(device=True)
+    a = va.sync({"w": jnp.full(4, 1.0, jnp.float32)})
+    b = vb.sync({"w": jnp.full(4, 2.0, jnp.float32)})
+    np.testing.assert_allclose(np.asarray(a["w"]), np.full(4, 1.0))
+    np.testing.assert_allclose(np.asarray(b["w"]), np.full(4, 3.0))
+    # next round: va sees vb's push; its own delta is zero
+    a2 = va.sync({"w": jnp.asarray(np.asarray(a["w"]))})
+    np.testing.assert_allclose(np.asarray(a2["w"]), np.full(4, 3.0))
+
+
+def test_device_sync_under_bsp():
+    """Pair sync through the SyncServer: the view must NOT trust the fused
+    at-apply-time reply (it cannot honor the round-gated Get contract) —
+    it re-pulls through a gated Get, so round-1 replies observe BOTH
+    round-1 adds."""
+    import threading
+
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    workers = 2
+    mv.init(sync=True, local_workers=workers)
+    try:
+        pm = PytreeParamManager({"w": jnp.zeros(4, jnp.float32)})
+        views = [pm.worker_view(device=True) for _ in range(workers)]
+        results = {}
+
+        def run(slot):
+            with mv.worker(slot):
+                t = {"w": jnp.full(4, float(slot + 1), jnp.float32)}
+                m = views[slot].sync(t)
+                results[slot] = np.asarray(m["w"]).copy()
+
+        threads = [threading.Thread(target=run, args=(s,))
+                   for s in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        # BSP: round-1 gets observe BOTH round-1 adds → identical replies
+        np.testing.assert_allclose(results[0], np.full(4, 3.0))
+        np.testing.assert_allclose(results[1], np.full(4, 3.0))
+    finally:
+        mv.shutdown()
+
+
+def test_device_sync_deterministic_fallback():
+    """DeterministicServer replies None to the pair sync (applies at
+    drain); the view falls back to a gated get and stays correct."""
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    mv.init(deterministic=True, local_workers=1)
+    try:
+        pm = PytreeParamManager({"w": jnp.zeros(4, jnp.float32)})
+        view = pm.worker_view(device=True)
+        with mv.worker(0):
+            m = view.sync({"w": jnp.full(4, 2.0, jnp.float32)})
+            np.testing.assert_allclose(np.asarray(m["w"]), np.full(4, 2.0))
+            m = view.sync({"w": jnp.asarray(np.asarray(m["w"])) + 1.0})
+            np.testing.assert_allclose(np.asarray(m["w"]), np.full(4, 3.0))
+    finally:
+        mv.shutdown()
+
+
+def test_pipelined_sync_accumulates_all_pushes(mv_env):
+    """sync_pipelined: k pushes of +1 must land exactly k in the table —
+    the two-baseline bookkeeping must not double-count or drop the
+    worker's own in-flight push."""
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    pm = PytreeParamManager({"w": jnp.zeros(6, jnp.float32)})
+    view = pm.worker_view(device=True)
+    consume = jax.jit(lambda t: jax.tree.map(lambda x: x * 0, t),
+                      donate_argnums=0)
+    t = {"w": jnp.full(6, 1.0, jnp.float32)}  # local progress +1 vs init 0
+    k = 5
+    for i in range(k):
+        ret = view.sync_pipelined(t)
+        # returned tree is one round stale: includes pushes 1..i-1
+        np.testing.assert_allclose(np.asarray(ret["w"]),
+                                   np.full(6, float(max(i - 1, 0) + (1 if i else 0))))
+        # next local value = returned + 1 (one more unit of local work)
+        t = jax.tree.map(lambda x: x + 1, ret)
+        consume(ret)
+    final = view.drain()
+    np.testing.assert_allclose(np.asarray(final["w"]), np.full(6, float(k)))
+    # table agrees
+    np.testing.assert_allclose(pm.table.get(), np.full(6, float(k)))
+
+
+def test_pipelined_sync_two_workers():
+    """Two pipelined views: every worker's deltas land exactly once."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    mv.init(local_workers=2)
+    try:
+        pm = PytreeParamManager({"w": jnp.zeros(4, jnp.float32)})
+        views = [pm.worker_view(device=True) for _ in range(2)]
+        rounds = 4
+
+        def run(slot):
+            with mv.worker(slot):
+                view = views[slot]
+                t = {"w": jnp.full(4, 1.0, jnp.float32)}
+                for _ in range(rounds):
+                    ret = view.sync_pipelined(t)
+                    t = jax.tree.map(lambda x: x + 1, ret)
+                view.drain()
+
+        threads = [threading.Thread(target=run, args=(s,)) for s in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive()
+        # each worker pushed +1 per round
+        np.testing.assert_allclose(pm.table.get(),
+                                   np.full(4, float(2 * rounds)))
+    finally:
+        mv.shutdown()
+
+
+def test_pipelined_then_blocking_sync_drains(mv_env):
+    """Mixing: a blocking sync() after pipelined calls settles the
+    outstanding push first (no lost deltas, no dead-buffer reads)."""
+    import jax.numpy as jnp
+    from multiverso_tpu.ext import PytreeParamManager
+
+    pm = PytreeParamManager({"w": jnp.zeros(3, jnp.float32)})
+    view = pm.worker_view(device=True)
+    ret = view.sync_pipelined({"w": jnp.full(3, 1.0, jnp.float32)})
+    # blocking sync with +1 local progress on top of the stale return
+    merged = view.sync({"w": jnp.asarray(np.asarray(ret["w"])) + 1.0})
+    np.testing.assert_allclose(np.asarray(merged["w"]), np.full(3, 2.0))

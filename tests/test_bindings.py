@@ -16,9 +16,10 @@ SO = NATIVE / "libmultiverso_tpu.so"
 
 
 def _build_native():
-    if not SO.exists():
-        subprocess.run(["make", "-C", str(NATIVE)], check=True,
-                       capture_output=True)
+    # unconditional: make is incremental, and a stale prebuilt .so after a
+    # c_api.h edit would otherwise fail these tests misleadingly
+    subprocess.run(["make", "-C", str(NATIVE)], check=True,
+                   capture_output=True)
     return ctypes.CDLL(str(SO))
 
 
@@ -74,3 +75,75 @@ def test_lua_cdef_matches_header_signatures():
     # for unparsed return types would silently escape verification
     assert set(hp) == _header_symbols()
     assert protos(cdef) == hp
+
+
+def test_csharp_pinvoke_matches_header_signatures():
+    """The C# DllImport signatures must be ABI-equivalent to the header's
+    prototypes: a drifted parameter type (int -> long, dropped arg) would
+    marshal garbage at runtime on a CLR host this image can't exercise."""
+    cs = (REPO / "bindings" / "csharp" / "MultiversoTPU.cs").read_text()
+    hdr = (NATIVE / "c_api.h").read_text()
+
+    # canonical ABI tokens shared by both sides
+    def c_canon(t):
+        t = re.sub(r"\bconst\b", "", t)
+        t = re.sub(r"\s+", " ", t).strip()
+        t = t.replace(" *", "*").replace("* ", "*")
+        return {
+            "void": "void", "int": "int", "int*": "int*",
+            "float*": "float*", "char*": "str", "char*[]": "strv",
+            "char**": "strv", "TableHandler": "handle",
+            "TableHandler*": "handle*",
+        }[t]
+
+    def cs_canon(t):
+        t = re.sub(r"\s+", " ", t).strip()
+        return {
+            "void": "void", "int": "int", "ref int": "int*",
+            "int[]": "int*", "float[]": "float*", "string": "str",
+            "string[]": "strv", "IntPtr": "handle",
+            "out IntPtr": "handle*",
+        }[t]
+
+    def c_protos(text):
+        out = {}
+        for m in re.finditer(
+                r"([\w][\w\s]*?\**)\s*(MV_\w+)\s*\(([^)]*)\)", text, re.S):
+            ret, name, args = m.group(1), m.group(2), m.group(3)
+            toks = []
+            args = re.sub(r"\s+", " ", args).strip()
+            if args:
+                for a in args.split(","):
+                    a = a.strip()
+                    arr = a.endswith("[]")
+                    if arr:
+                        a = a[:-2].strip()
+                    # drop the parameter name (last word)
+                    ty = re.sub(r"\s*\w+$", "", a).strip() or a
+                    toks.append(c_canon(ty + ("[]" if arr else "")))
+            out[name] = (c_canon(ret.strip()), tuple(toks))
+        return out
+
+    def cs_protos(text):
+        out = {}
+        for m in re.finditer(
+                r"static extern\s+([\w\[\]]+)\s+(MV_\w+)\s*\(([^)]*)\)\s*;",
+                text, re.S):
+            ret, name, args = m.group(1), m.group(2), m.group(3)
+            toks = []
+            args = re.sub(r"\s+", " ", args).strip()
+            if args:
+                for a in args.split(","):
+                    # drop the parameter name (last word); keep ref/out
+                    ty = re.sub(r"\s*\w+$", "", a.strip()).strip()
+                    toks.append(cs_canon(ty))
+            out[name] = (cs_canon(ret), tuple(toks))
+        return out
+
+    hp = c_protos(hdr)
+    assert set(hp) == _header_symbols()  # the parser covers the surface
+    cp = cs_protos(cs)
+    assert set(cp) == set(hp), "C# surface != header surface"
+    for name in sorted(hp):
+        assert cp[name] == hp[name], (
+            f"{name}: C# {cp[name]} != header {hp[name]}")
