@@ -252,7 +252,11 @@ def bench_matrix_table(rows=1_000_000, cols=50, batch_rows=1024):
         return per_op if per_op > 0 else b2 / k2
 
     data = jnp.zeros((rows, padded_cols), jnp.float32)
-    k1, k2 = (100, 1100) if on_tpu else (2, 12)
+    # k2-k1 sets the signal the slope measures: at ~27us/op, 3000 ops is
+    # ~80ms of device work vs the tunnel's ~10-20ms per-fetch RTT jitter —
+    # the old 1000-op delta let RTT jitter show up as tens of us/op
+    # run-to-run (observed 28 vs 98 us in adjacent runs)
+    k1, k2 = (200, 3200) if on_tpu else (2, 12)
     add_per_op = slope(make_add, (data, base, vals), k1, k2)
     get_per_op = slope(make_get, (data, base), k1, k2)
 

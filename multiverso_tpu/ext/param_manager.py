@@ -229,8 +229,7 @@ class PytreeWorkerSync:
                 last = self._last_handed
                 self._last_handed = None
             server = self._zoo.server
-            if (getattr(server, "gates_gets", False)
-                    or getattr(server, "defers_adds", False)):
+            if not getattr(server, "plain_async", False):
                 # BSP (fused reply samples at apply time — cannot honor
                 # the round-gated Get contract) or deferred-apply
                 # (deterministic: fused reply would be None): reply-free
@@ -273,9 +272,8 @@ class PytreeWorkerSync:
         would subtract the worker's own in-flight push from its next
         delta. Falls back to blocking :meth:`sync` on servers that gate
         or defer (BSP/deterministic), where rounds cannot overlap."""
-        server = self._zoo.server
-        if (not self._device or getattr(server, "gates_gets", False)
-                or getattr(server, "defers_adds", False)):
+        if not self._device or not getattr(self._zoo.server,
+                                           "plain_async", False):
             return self.sync(tree)
         leaves, treedef = self._jax.tree_util.tree_flatten(tree)
         if treedef != self._treedef:

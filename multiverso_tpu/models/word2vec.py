@@ -864,8 +864,11 @@ class PSTrainer:
                 return ((params["w_in"] - w_in) * scale,
                         (params["w_out"] - w_out) * scale, stats)
 
+            self._fast_delta_raw = fast_delta  # traceable, for the txn jit
             self._fast_delta_fn = jax.jit(fast_delta, donate_argnums=(0, 1))
             self._fast_key = jax.random.PRNGKey(config.seed + 1)
+            self._fast_key_queue: list = []  # pre-split batch, see below
+            self._txn_fn = None  # built lazily: needs in-process servers
             # cap on the per-block negative pool (draw volume otherwise
             # tracks the old per-pair path: ~len(block)*window*negatives)
             self.neg_pool = 16384
@@ -1051,6 +1054,60 @@ class PSTrainer:
                 "n_in": n_in, "n_out": n_out, "pairs": p,
                 "block_len": int(len(block))}
 
+    def _can_transact(self) -> bool:
+        """Device transactions need in-process tables (the fused jit reads
+        the servers' device state directly) and the plain async server
+        (BSP/deterministic keep per-table clocks a cross-table transaction
+        cannot honor — those fall back to the staged pull/push path)."""
+        if (getattr(self.input_table, "_server_table", None) is None
+                or getattr(self.output_table, "_server_table", None) is None):
+            return False
+        if not hasattr(self.input_table, "transact_device_async"):
+            return False
+        from multiverso_tpu.runtime.zoo import Zoo
+        return getattr(Zoo.instance().server, "plain_async", False)
+
+    def _build_txn_fn(self) -> None:
+        """The whole PS block as one fused jit over both tables' device
+        state: gather candidate rows, run the roll-formulation kernel,
+        apply both tables' updates (linear scatter or server-side AdaGrad
+        row update), return the stats scalar triple."""
+        apply_in = self.input_table._server_table.row_apply_traceable()
+        apply_out = self.output_table._server_table.row_apply_traceable()
+        fast_delta = self._fast_delta_raw
+        pc_in = self.input_table._server_table.padded_cols
+        pc_out = self.output_table._server_table.padded_cols
+        dim = self.config.dim
+
+        def txn(datas, states, packed, key, lr, scale, worker, scalars,
+                b_in, b_out, n_chunks, chunk):
+            # `packed` is ONE int32 upload [ids_in | ids_out | blocks_c |
+            # slot_alias] — four separate host->device transfers per block
+            # would each pay the tunnel's per-transfer submission cost.
+            # The section sizes are static (pow2-bucketed), so slicing is
+            # free at trace time.
+            data_in, data_out = datas
+            st_in, st_out = states
+            ids_in = packed[:b_in]
+            ids_out = packed[b_in:b_in + b_out]
+            o = b_in + b_out
+            blocks_c = packed[o:o + n_chunks * chunk].reshape(
+                (n_chunks, chunk))
+            slot_alias = packed[o + n_chunks * chunk:]
+            d_in, d_out, stats = fast_delta(
+                data_in[ids_in], data_out[ids_out], key, blocks_c,
+                slot_alias, lr, scale)
+            d_in = jnp.pad(d_in, ((0, 0), (0, pc_in - dim)))
+            d_out = jnp.pad(d_out, ((0, 0), (0, pc_out - dim)))
+            data_in, st_in = apply_in(data_in, st_in, ids_in, d_in,
+                                      worker, scalars)
+            data_out, st_out = apply_out(data_out, st_out, ids_out, d_out,
+                                         worker, scalars)
+            return [data_in, data_out], [st_in, st_out], stats
+
+        self._txn_fn = jax.jit(txn, donate_argnums=(0, 1),
+                               static_argnums=(8, 9, 10, 11))
+
     def _submit_block_fast(self, block: np.ndarray, lr: float
                            ) -> Optional[Dict]:
         """sg+ns device fast path: run the roll-formulation block kernel
@@ -1074,19 +1131,23 @@ class PSTrainer:
             max(1024, len(block) * self.config.window
                 * self.config.negatives)))
         draws = self._neg_draw(self.rng, (p_draws,)).reshape(-1)
-        pool_only = np.setdiff1d(np.unique(draws), blk_u).astype(np.int32)
+        # vocab->compact-slot lookup table: one O(V) fill + O(draws)
+        # gathers replace setdiff1d + three searchsorted calls (measured
+        # 3.7 ms/block of host time at 8k-token blocks, the largest single
+        # submit cost after the dispatch fusion)
+        lut = np.full(self.config.vocab_size, -1, np.int32)
+        lut[blk_u] = np.arange(n_blk, dtype=np.int32)
+        pool_only = np.unique(draws[lut[draws] < 0]).astype(np.int32)
+        lut[pool_only] = n_blk + np.arange(len(pool_only), dtype=np.int32)
         ids_out = np.concatenate([blk_u, pool_only])
-        # slot of each pool draw in the compact out space
-        pos = np.searchsorted(blk_u, draws)
-        in_blk = (pos < n_blk) & (blk_u[np.minimum(pos, n_blk - 1)] == draws)
-        slot_alias = np.where(
-            in_blk, pos,
-            n_blk + np.searchsorted(pool_only, draws)).astype(np.int32)
+        slot_alias = lut[draws]
 
-        h_in = self.input_table.get_device_async(blk_u)
-        h_out = self.output_table.get_device_async(ids_out)
-        cached_in = self.input_table.wait_device(h_in, blk_u)
-        cached_out = self.output_table.wait_device(h_out, ids_out)
+        use_txn = self._can_transact()
+        if not use_txn:
+            h_in = self.input_table.get_device_async(blk_u)
+            h_out = self.output_table.get_device_async(ids_out)
+            cached_in = self.input_table.wait_device(h_in, blk_u)
+            cached_out = self.output_table.wait_device(h_out, ids_out)
 
         # Chunk the block INSIDE the one scan dispatch at roughly the
         # pair path's update granularity (batch_pairs pairs ~ bp/window
@@ -1101,11 +1162,56 @@ class PSTrainer:
             chunk *= G  # keep the grouped-negatives constraint
         n_chunks = _next_pow2(-(-len(block) // chunk))
         blocks_c = np.full((n_chunks, chunk), -1, np.int32)
-        flat = np.searchsorted(blk_u, block).astype(np.int32)
+        flat = lut[block]  # vocab->slot lut built above
         blocks_c.reshape(-1)[: len(block)] = flat
 
-        self._fast_key, sub = jax.random.split(self._fast_key)
+        if not self._fast_key_queue:
+            # one split dispatch per 64 blocks, not per block: each device
+            # dispatch submission costs ~1-3 ms through the tunnel
+            keys = jax.random.split(self._fast_key, 65)
+            self._fast_key = keys[0]
+            self._fast_key_queue = list(keys[1:])
+        sub = self._fast_key_queue.pop()
         scale = (-1.0 / lr) if self.use_adagrad else 1.0
+
+        if use_txn:
+            # ONE dispatcher op, ONE device dispatch: gather both tables'
+            # candidate rows, train, and apply both updates inside a
+            # single fused jit over the tables' (donated) device state —
+            # the 2-pull + kernel + 2-push staging collapses (each
+            # dispatch submission costs ~1-3 ms through the tunnel)
+            if self._txn_fn is None:
+                self._build_txn_fn()
+            from multiverso_tpu.ops.pallas_rows import ROW_GROUP
+            from multiverso_tpu.updaters import AddOption
+            b_in = max(_next_pow2(n_blk + 1), ROW_GROUP)
+            b_out = max(_next_pow2(len(ids_out) + 1), ROW_GROUP)
+            ids_in_p = np.concatenate(
+                [blk_u, np.full(b_in - n_blk,
+                                self.input_table.sentinel_row, np.int32)])
+            ids_out_p = np.concatenate(
+                [ids_out, np.full(b_out - len(ids_out),
+                                  self.output_table.sentinel_row,
+                                  np.int32)])
+            opt = AddOption(
+                worker_id=self.input_table._channel.worker_id(),
+                learning_rate=lr)
+            worker, scalars = (
+                self.input_table._server_table._option_consts(opt))
+            packed = jnp.asarray(np.concatenate(
+                [ids_in_p, ids_out_p, blocks_c.reshape(-1), slot_alias]))
+            h = self.input_table.transact_device_async(
+                self._txn_fn, [self.output_table],
+                args=(packed, sub, lr, scale, worker, scalars,
+                      b_in, b_out, blocks_c.shape[0], blocks_c.shape[1]))
+            # the candidate gathers still happen (inside the fused jit) —
+            # they just never leave HBM; keep the pull accounting so
+            # "bytes ∝ candidate rows" stays observable
+            self.input_table.rows_pulled += n_blk
+            self.output_table.rows_pulled += len(ids_out)
+            return {"txn": h, "block_len": len(block), "n_in": n_blk,
+                    "n_out": len(ids_out), "pairs": -1, "stats": None}
+
         delta_in, delta_out, stats = self._fast_delta_fn(
             cached_in, cached_out, sub, jnp.asarray(blocks_c),
             jnp.asarray(slot_alias), lr, scale)
@@ -1143,9 +1249,17 @@ class PSTrainer:
         default fetching path."""
         if pend is None:
             return 0.0
-        # overlapped pushes; waits reclaim the completions
-        self.input_table.wait(pend["a1"])
-        self.output_table.wait(pend["a2"])
+        if "txn" in pend:
+            # fused transaction: one completion carries the stats triple
+            pend["stats"] = self.input_table.wait(pend["txn"])
+            if fetch_stats and pend["stats"] is not None:
+                # start the device->host copy before the count-table round
+                # trip below so the tunnel RTTs overlap
+                pend["stats"].copy_to_host_async()
+        else:
+            # overlapped pushes; waits reclaim the completions
+            self.input_table.wait(pend["a1"])
+            self.output_table.wait(pend["a2"])
         self.count_table.add([0], [pend["block_len"]])
         self.words_trained += pend["block_len"]
         self.last_block_stats = {"in_rows": pend["n_in"],

@@ -395,6 +395,10 @@ class _RemoteMatrixWorker(MatrixWorker):
         log.fatal("device IO is in-process only; remote tables use "
                   "get/get_async (host arrays)")
 
+    def transact_device_async(self, fn, others, args=(), touched=None):
+        log.fatal("device IO is in-process only; remote tables use "
+                  "add/add_async (host arrays)")
+
     def add_device_async(self, values, row_ids, option=None):
         log.fatal("device IO is in-process only; remote tables use "
                   "add/add_async (host arrays)")

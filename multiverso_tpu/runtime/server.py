@@ -47,6 +47,14 @@ class Server:
     # should send reply-free pushes and pull separately.
     defers_adds = False
 
+    @property
+    def plain_async(self) -> bool:
+        """True iff fused add+get replies are trustworthy and cross-table
+        device transactions are admissible — the single capability check
+        clients use (derived, so a subclass setting either gating attr
+        cannot forget to flip it)."""
+        return not (self.gates_gets or self.defers_adds)
+
     def __init__(self, num_workers: int) -> None:
         self.num_workers = num_workers
         self._tables: Dict[int, "object"] = {}  # table_id -> ServerTable

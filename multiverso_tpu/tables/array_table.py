@@ -80,24 +80,6 @@ class ArrayServer(ServerTable):
 
         self._update = _make_whole_update(self.updater)
         self._codecs: Dict = {}  # leaf-signature -> (to_flat, from_flat)
-        # (scalars tuple, worker) -> device constants. Every add would
-        # otherwise pay two host->device transfers for a 4-float envelope
-        # and a worker index — measurable against the per-dispatch floor
-        # on tunneled TPUs (the ASGD hot path sends identical envelopes
-        # every sync)
-        self._opt_cache: Dict = {}
-
-    def _option_consts(self, option: "AddOption"):
-        key = (option.scalars(), int(option.worker_id))
-        cached = self._opt_cache.get(key)
-        if cached is None:
-            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
-            worker = jnp.int32(max(option.worker_id, 0)
-                               % max(1, self.num_workers))
-            cached = (worker, scalars)
-            if len(self._opt_cache) < 4096:  # bound pathological churn
-                self._opt_cache[key] = cached
-        return cached
 
     # -- server ops --------------------------------------------------------
     def _leaf_codec(self, leaves):

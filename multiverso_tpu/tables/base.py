@@ -17,6 +17,7 @@ callers written against the reference's API port 1:1.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from multiverso_tpu import log
@@ -148,6 +149,33 @@ class ServerTable:
 
     def __init__(self) -> None:
         self.table_id: int = -1
+        # (scalars tuple, worker) -> device constants, LRU-bounded. A
+        # repeated AddOption envelope (fixed-lr hot paths) hits the cache
+        # and skips two host->device transfers per add; a churning
+        # envelope (per-block lr decay) misses but cannot pin more than
+        # _OPT_CACHE_MAX dead device buffers.
+        self._opt_cache: "OrderedDict" = OrderedDict()
+
+    _OPT_CACHE_MAX = 256
+
+    def _option_consts(self, option):
+        """Device constants (worker index, scalars envelope) for an
+        AddOption, cached so identical envelopes upload once. Requires
+        ``self.num_workers``."""
+        import jax.numpy as jnp
+        key = (option.scalars(), int(option.worker_id))
+        cached = self._opt_cache.get(key)
+        if cached is None:
+            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
+            worker = jnp.int32(max(option.worker_id, 0)
+                               % max(1, self.num_workers))
+            cached = (worker, scalars)
+            self._opt_cache[key] = cached
+            if len(self._opt_cache) > self._OPT_CACHE_MAX:
+                self._opt_cache.popitem(last=False)
+        else:
+            self._opt_cache.move_to_end(key)
+        return cached
 
     def remote_spec(self) -> Optional[Dict[str, Any]]:
         """Metadata a remote client needs to build a matching worker proxy

@@ -26,7 +26,7 @@ TPU-native re-design:
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -130,14 +130,17 @@ class MatrixServer(ServerTable):
             jax.default_backend(), num_shards)
         if self._pallas_scatter:
             from multiverso_tpu.ops.pallas_rows import scatter_add_rows
-            self._scatter_add = scatter_add_rows  # unique-id contract: see process_add
+            # unique-id contract: see process_add
+            self._scatter_add_raw = scatter_add_rows
+            self._scatter_add = scatter_add_rows
         else:
-            self._scatter_add = jax.jit(
-                lambda data, ids, delta: data.at[ids].add(delta),
-                donate_argnums=(0,))
+            self._scatter_add_raw = lambda data, ids, delta: (
+                data.at[ids].add(delta))
+            self._scatter_add = jax.jit(self._scatter_add_raw,
+                                        donate_argnums=(0,))
         self._row_update = self._make_row_update(self.updater)
 
-    def _make_row_update(self, updater: Updater):
+    def _make_row_update(self, updater: Updater, jit: bool = True):
         def f(data, states, ids, delta, worker, scalars):
             rows = data[ids]
             if updater.per_worker_state:
@@ -152,7 +155,24 @@ class MatrixServer(ServerTable):
                 new_states = {k: states[k].at[0, ids].set(new_sliced[k]) for k in states}
             return data, new_states
 
-        return jax.jit(f, donate_argnums=(0, 1))
+        return jax.jit(f, donate_argnums=(0, 1)) if jit else f
+
+    def row_apply_traceable(self):
+        """The per-row update as a TRACEABLE function
+        ``(data, states, ids, delta, worker, scalars) -> (data, states)``
+        for embedding in a caller's fused jit (device transactions).
+        Same semantics as the add path: linear updaters reduce to a
+        scatter-add (sign folded in), stateful updaters run the row
+        update. ``ids`` must be unique apart from sentinel pads with
+        zero deltas."""
+        if self._linear:
+            sign, scatter = self._sign, self._scatter_add_raw
+
+            def apply_linear(data, states, ids, delta, worker, scalars):
+                return scatter(data, ids, sign * delta), states
+
+            return apply_linear
+        return self._make_row_update(self.updater, jit=False)
 
     # -- helpers -----------------------------------------------------------
     def _bucket_ids(self, ids: np.ndarray, values: Optional[np.ndarray],
@@ -177,12 +197,13 @@ class MatrixServer(ServerTable):
         return jnp.asarray(ids_p), vals_p, n
 
     # -- server ops --------------------------------------------------------
-    def process_add(self, request) -> None:
+    def process_add(self, request):
+        if isinstance(request[0], str) and request[0] == "transact":
+            return self._process_transact(request)
         row_ids, values, option = request
         option = option or AddOption()
-        scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
         # administrative access (worker id -1) charges slot 0, not slot n-1
-        worker = jnp.int32(max(option.worker_id, 0) % max(1, self.num_workers))
+        worker, scalars = self._option_consts(option)
         if isinstance(values, jax.Array):
             # Device add (the LocalForward analog: an in-process worker's
             # delta never touches the host — reference local messages
@@ -249,6 +270,35 @@ class MatrixServer(ServerTable):
             with self._std_lock:
                 live = row_ids[row_ids < self.num_row]
                 self._up_to_date[:, live] = False
+
+    def _process_transact(self, request):
+        """Device transaction: ONE dispatcher op that reads several tables'
+        device state, runs a caller-built fused jit over all of it, and
+        writes the results back atomically (w.r.t. the dispatcher's
+        serialization). The TPU-era answer to the reference's multi-table
+        block protocols (pull rows from 2+ tables, train, push deltas —
+        communicator.cpp RequestParameter/AddDeltaParameter): instead of
+        2N messages and 2N+1 device dispatches, the whole block is one
+        message and one dispatch with donated table buffers.
+
+        request = ("transact", fn, other_servers, args, touched):
+        ``fn(datas, states, *args) -> (new_datas, new_states, extra)``
+        over lists ordered [this table, *other_servers]; ``extra`` is the
+        reply (stays on device). ``touched`` (per-table id arrays or None)
+        drives sparse-staleness invalidation."""
+        _, fn, others, args, touched = request
+        tables = [self] + list(others)
+        datas = [t.data for t in tables]
+        states = [t.states for t in tables]
+        new_datas, new_states, extra = fn(datas, states, *args)
+        for t, d, s in zip(tables, new_datas, new_states):
+            t.data, t.states = d, s
+        for t, ids in zip(tables, touched or [None] * len(tables)):
+            if getattr(t, "is_sparse", False) and ids is not None:
+                with t._std_lock:
+                    live = ids[ids < t.num_row]
+                    t._up_to_date[:, live] = False
+        return extra
 
     def _is_worker(self, option) -> bool:
         """Administrative access (worker id outside [0, num_slots), e.g.
@@ -460,6 +510,38 @@ class MatrixWorker(WorkerTable):
         option = self._default_add_option(option)
         return super().add_async(
             (np.asarray(row_ids, np.int32).reshape(-1), values, option))
+
+    def transact_device_async(self, fn, others: Sequence["MatrixWorker"],
+                              args: tuple = (),
+                              touched: Optional[Sequence] = None) -> int:
+        """Submit a fused multi-table device transaction (one dispatcher
+        op, one device dispatch): ``fn(datas, states, *args) ->
+        (new_datas, new_states, extra)`` over the device state of
+        ``[this table, *others]``, with ``extra`` as the (device) reply.
+        ``fn`` should be jitted with ``donate_argnums=(0, 1)`` — the
+        tables' buffers are updated in place.
+
+        In-process only, plain async server only: round-gated/deferred
+        servers (BSP/deterministic) account per-table clocks that a
+        cross-table transaction cannot honor — callers check the server's
+        ``gates_gets``/``defers_adds`` and use the staged pull/push path
+        there."""
+        if self.is_sparse:
+            log.fatal("device IO is not available on is_sparse tables")
+        server = Zoo.instance().server
+        if not getattr(server, "plain_async", False):
+            log.fatal("transact_device_async requires the plain async "
+                      "server (BSP/deterministic servers keep per-table "
+                      "clocks a cross-table transaction cannot honor)")
+        other_servers = []
+        for o in others:
+            st = getattr(o, "_server_table", None)
+            if st is None:
+                log.fatal("transact_device_async: %r is not an in-process "
+                          "table", o)
+            other_servers.append(st)
+        return super().add_async(("transact", fn, other_servers,
+                                  tuple(args), touched))
 
     @property
     def sentinel_row(self) -> int:

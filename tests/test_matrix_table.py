@@ -180,3 +180,13 @@ def test_matrix_int_dtype(mv_env):
     table = mv.create_table("matrix", 4, 4, np.int32)
     table.add(np.full((4, 4), 2, np.int32))
     np.testing.assert_array_equal(table.get(), np.full((4, 4), 2))
+
+
+def test_transact_refused_on_sparse_table(mv_env):
+    """Device transactions are refused on is_sparse tables (their client
+    cache is host-resident; a transaction would bypass staleness
+    bookkeeping), like the sibling device-IO methods."""
+    table = mv.create_table("matrix", 8, 4, np.float32, is_sparse=True)
+    with pytest.raises(mv.log.FatalError):
+        table.transact_device_async(
+            lambda datas, states: (datas, states, None), [])

@@ -251,8 +251,9 @@ def test_ps_pipelined_train_matches_serial_volume(mv_env):
 
 def test_ps_device_io_used_in_process(mv_env):
     """In-process PSTrainer takes the device path (the LocalForward
-    analog): the submit record carries a device stats array, and pulls are
-    still counted per candidate row."""
+    analog) — on the plain async server that's the fused transaction (one
+    dispatcher op per block); pulls are still counted per candidate row
+    and the stats triple arrives at finish."""
     vocab = 30
     rng = np.random.default_rng(4)
     corpus = _synthetic_corpus(rng, vocab, n=2000)
@@ -261,8 +262,9 @@ def test_ps_device_io_used_in_process(mv_env):
                             batch_pairs=512, sample=0.0)
     trainer = PSTrainer(config, d)
     pend = trainer.submit_block(corpus[:1000])
-    assert pend is not None and pend["stats"] is not None  # device path
+    assert pend is not None and "txn" in pend  # fused transaction path
     loss = trainer.finish_block(pend)
+    assert pend["stats"] is not None  # device stats triple, post-wait
     assert np.isfinite(loss)
     assert trainer.input_table.rows_pulled == pend["n_in"]
 
@@ -399,3 +401,53 @@ def test_training_separates_clusters_neg_sharing():
     trainer.train(blocks, epochs=10)
     score = _cluster_score(trainer.embeddings(), vocab)
     assert score > 0.3, f"neg_sharing=8 failed to learn: {score}"
+
+
+def test_ps_txn_matches_staged_path(mv_env):
+    """The fused transaction must train the same model as the staged
+    pull/kernel/push path: same RNG stream, same kernel, same updates —
+    only the dispatch structure differs."""
+    vocab = 200
+    rng = np.random.default_rng(7)
+    corpus = _synthetic_corpus(rng, vocab, n=3000)
+    d = _toy_dictionary(corpus, vocab)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=3,
+                            batch_pairs=512, sample=0.0, seed=11)
+
+    def train(force_staged):
+        trainer = PSTrainer(config, d)
+        if force_staged:
+            trainer._can_transact = lambda: False
+        for lo in range(0, 3000, 1000):
+            trainer.train_block(corpus[lo:lo + 1000])
+        return trainer.embeddings()
+
+    fused = train(False)
+    staged = train(True)
+    np.testing.assert_allclose(fused, staged, rtol=2e-4, atol=2e-5)
+
+
+def test_ps_txn_refused_under_bsp():
+    """BSP server: the trainer must fall back to the staged path (per-table
+    round clocks cannot account a cross-table transaction), and a direct
+    transact call must fail loudly."""
+    import multiverso_tpu as mv
+
+    mv.init(sync=True, local_workers=1)
+    try:
+        vocab = 40
+        rng = np.random.default_rng(5)
+        corpus = _synthetic_corpus(rng, vocab, n=1500)
+        d = _toy_dictionary(corpus, vocab)
+        config = Word2VecConfig(vocab_size=vocab, dim=16, window=2,
+                                negatives=3, batch_pairs=512, sample=0.0)
+        trainer = PSTrainer(config, d)
+        # the trainer detects the gated server and will use the staged
+        # path (BSP's round structure additionally requires add-first
+        # ordering, which the epoch loop provides)
+        assert not trainer._can_transact()
+        with pytest.raises(mv.log.FatalError):
+            trainer.input_table.transact_device_async(
+                lambda datas, states: (datas, states, None), [])
+    finally:
+        mv.shutdown()
