@@ -290,7 +290,20 @@ class MatrixServer(ServerTable):
         tables = [self] + list(others)
         datas = [t.data for t in tables]
         states = [t.states for t in tables]
-        new_datas, new_states, extra = fn(datas, states, *args)
+        out = fn(datas, states, *args)
+        try:
+            new_datas, new_states, extra = out
+            if (len(new_datas) != len(tables)
+                    or len(new_states) != len(tables)):
+                raise ValueError("result lists do not match table count")
+        except (TypeError, ValueError) as exc:
+            # the fn's jit has already executed and DONATED every table's
+            # live buffers — there is nothing to roll back to. Die loudly
+            # with the reason rather than serving dead buffers forever.
+            log.fatal("transact fn must return (new_datas, new_states, "
+                      "extra) matching the %d-table list (%s); the tables' "
+                      "donated state is unrecoverable — recreate them",
+                      len(tables), exc)
         for t, d, s in zip(tables, new_datas, new_states):
             t.data, t.states = d, s
         for t, ids in zip(tables, touched or [None] * len(tables)):
@@ -539,6 +552,12 @@ class MatrixWorker(WorkerTable):
             if st is None:
                 log.fatal("transact_device_async: %r is not an in-process "
                           "table", o)
+            if getattr(o, "is_sparse", False) or getattr(st, "is_sparse",
+                                                         False):
+                # same guard as self: a transaction with touched=None
+                # would silently skip staleness invalidation and serve
+                # other workers stale rows from their client caches
+                log.fatal("device IO is not available on is_sparse tables")
             other_servers.append(st)
         return super().add_async(("transact", fn, other_servers,
                                   tuple(args), touched))
