@@ -31,6 +31,18 @@ def _fetch(x):
     return np.asarray(x)
 
 
+def _tpu_reps(tpu_reps, cpu_reps, sleep_s=1.5):
+    """Repeat counter for burst-robust sections: more reps on the shared
+    tunneled TPU, with a spacing sleep between them so seconds-scale load
+    bursts cannot span every sample."""
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    for rep in range(tpu_reps if on_tpu else cpu_reps):
+        if rep and on_tpu:
+            time.sleep(sleep_s)
+        yield rep
+
+
 def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
     import jax
 
@@ -146,7 +158,7 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
 
         def run(k):
             best = float("inf")
-            for _ in range(3):
+            for _ in _tpu_reps(5, 3):
                 t0 = time.perf_counter()
                 pend = None
                 for i in range(k):
@@ -371,7 +383,7 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
         # bursts last seconds, and a burst landing on one single-shot
         # measurement otherwise fabricates the overhead ratio
         t_plain = t_sync = t_pipe = float("inf")
-        for _ in range(3):
+        for _ in _tpu_reps(5, 3):
             t0 = time.perf_counter()
             state = run(steps, state)
             t_plain = min(t_plain, (time.perf_counter() - t0) / steps)
@@ -399,7 +411,43 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
     }
 
 
+def wait_for_quiet(threshold_gbps=300.0, max_wait_s=120.0, probe_mb=128):
+    """The tunneled TPU is time-shared: sustained external load (minutes,
+    not the seconds-scale bursts the per-section minima already absorb)
+    can depress every figure 2-5x. Probe achieved HBM bandwidth with a
+    small donated-pass loop and, if it is far below the chip's quiet
+    ~760+ GB/s, wait briefly for the load to clear. Bounded: proceeds
+    after ``max_wait_s`` regardless and reports the last probe so a
+    loaded run is at least labeled."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    n = probe_mb * 1024 * 1024 // 4
+    dense = jax.jit(lambda d: d + 1.0, donate_argnums=(0,))
+    waited = 0.0
+    gbps = 0.0
+    while True:
+        d = dense(jnp.zeros(n, jnp.float32))
+        _fetch(d[:1])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                d = dense(d)
+            _fetch(d[:1])
+            best = min(best, time.perf_counter() - t0)
+        gbps = 8 * n * 4 * 2 / best / 1e9
+        if gbps >= threshold_gbps or waited >= max_wait_s:
+            break
+        time.sleep(15.0)
+        waited += 15.0
+    return round(gbps, 1)
+
+
 def main():
+    probe_gbps = wait_for_quiet()
     words_per_sec, final_loss = bench_word2vec()
     ps = bench_ps_word2vec()
     matrix = bench_matrix_table()
@@ -424,6 +472,10 @@ def main():
         **matrix,
         **resnet,
     }
+    if probe_gbps is not None:
+        # pre-run shared-chip load probe (quiet ~760+ GB/s): a low value
+        # labels a run measured under sustained external load
+        result["chip_probe_gbps"] = probe_gbps
     print(json.dumps(result))
 
 

@@ -27,16 +27,21 @@ C2I = {c: i for i, c in enumerate(CHARS)}
 
 
 def make_dataset(n, digits, rng):
-    """Encoded question/answer pairs, keras-example style: questions are
-    zero-padded to ``2*digits+1`` chars and REVERSED (the published trick —
-    it shortens the dependency span the LSTM must bridge), answers padded
-    to ``digits+1``."""
+    """Encoded question/answer pairs, keras-example style: DISTINCT
+    questions only (the reference deduplicates via a `seen` set, so val
+    accuracy measures generalization, not memorization), padded to
+    ``2*digits+1`` chars and REVERSED (the published trick — it shortens
+    the dependency span the LSTM must bridge), answers padded to
+    ``digits+1``. ``n`` is capped at the number of possible questions."""
     q_len, a_len = 2 * digits + 1, digits + 1
-    a = rng.integers(0, 10 ** digits, size=n)
-    b = rng.integers(0, 10 ** digits, size=n)
+    space = 10 ** digits
+    n = min(n, space * space)
+    # sample n distinct (a, b) pairs by drawing distinct flat indices
+    flat = rng.choice(space * space, size=n, replace=False)
     X = np.zeros((n, q_len), np.int32)
     Y = np.zeros((n, a_len), np.int32)
-    for i, (x, y) in enumerate(zip(a, b)):
+    for i, f in enumerate(flat):
+        x, y = int(f) // space, int(f) % space
         q = f"{x}+{y}".ljust(q_len)[::-1]
         ans = str(x + y).ljust(a_len)
         X[i] = [C2I[c] for c in q]
@@ -102,6 +107,7 @@ def main(digits=2, hidden=128, n=20000, epochs=20, batch=128, lr=1e-3,
 
         order = np.arange(len(Xt))
         loss = float("nan")  # stays nan if the split is under one batch
+        acc = 0.0            # defined even for epochs=0
         for epoch in range(epochs):
             rng.shuffle(order)
             p = pm.params

@@ -1147,6 +1147,12 @@ class PSTrainer:
         lut[pool_only] = n_blk + np.arange(len(pool_only), dtype=np.int32)
         ids_out = np.concatenate([blk_u, pool_only])
         slot_alias = lut[draws]
+        flat = lut[block]
+        # reset IMMEDIATELY (pure numpy since the fill — nothing can raise
+        # in between): a dirty persistent lut would silently map the next
+        # block's draws onto THIS block's compact slots
+        lut[blk_u] = -1
+        lut[pool_only] = -1
 
         use_txn = self._can_transact()
         if not use_txn:
@@ -1168,12 +1174,7 @@ class PSTrainer:
             chunk *= G  # keep the grouped-negatives constraint
         n_chunks = _next_pow2(-(-len(block) // chunk))
         blocks_c = np.full((n_chunks, chunk), -1, np.int32)
-        flat = lut[block]  # vocab->slot lut built above
-        blocks_c.reshape(-1)[: len(block)] = flat
-        # reset ONLY the entries this block wrote: the persistent lut must
-        # read all -1 at the top of the next block
-        lut[blk_u] = -1
-        lut[pool_only] = -1
+        blocks_c.reshape(-1)[: len(block)] = flat  # lut-remapped above
 
         if not self._fast_key_queue:
             # one split dispatch per 64 blocks, not per block: each device

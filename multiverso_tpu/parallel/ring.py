@@ -1,0 +1,168 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention (its long-context axis was table size, not
+sequence length — SURVEY §5), but the table-sharding seam it leaves open
+(`PartitionSpec` over rows) is exactly where a sequence axis attaches. This
+module provides the two standard TPU-native long-sequence strategies over a
+mesh axis, so models built on this framework scale sequence length across
+chips the way tables already scale parameter count:
+
+* :func:`ring_attention` — blockwise attention with the K/V shards rotating
+  around the mesh axis via ``lax.ppermute`` (one neighbor hop per step, so
+  the traffic rides ICI), accumulated with a streaming numerically-stable
+  softmax (the flash/online-softmax recurrence). Peak memory per chip is
+  O(T_local² · heads) instead of O(T²), and K/V transfers overlap compute
+  chunk-for-chunk under XLA's latency-hiding scheduler.
+* :func:`ulysses_all_to_all` — the all-to-all reshard between
+  sequence-parallel layout (heads replicated, sequence split) and
+  head-parallel layout (sequence replicated locally, heads split), which
+  turns any single-device attention kernel into a sequence-parallel one
+  when the head count divides the axis size.
+
+Both are plain traceable functions meant for use inside ``shard_map`` over
+a ``Mesh`` axis; see ``tests/test_ring.py`` for the exact-equality harness
+against full-sequence attention on the virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_scores(q, k, scale):
+    # q: (B, Tq, H, D), k: (B, Tk, H, D) -> (B, H, Tq, Tk)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False,
+                   q_offset: Optional[jax.Array] = None,
+                   bias_fn=None) -> jax.Array:
+    """Blockwise ring attention over mesh axis ``axis_name``.
+
+    Args:
+      q, k, v: per-shard ``(B, T_local, H, D)`` blocks of a global
+        ``(B, T, H, D)`` sequence sharded on T. Call inside ``shard_map``
+        with T mapped over ``axis_name``.
+      causal: apply a causal mask using GLOBAL positions (shard i's tokens
+        occupy ``[i*T_local, (i+1)*T_local)``; contiguous sharding assumed).
+      q_offset: optional per-shard global offset of this block's first
+        query token; defaults to ``axis_index * T_local``.
+      bias_fn: optional ``bias_fn(q_pos, kv_pos) -> bias`` called once per
+        ring step with the GLOBAL query/key position vectors ``(Tq,)`` /
+        ``(Tk,)``; the returned bias (broadcastable to ``(B, H, Tq, Tk)``,
+        e.g. a T5-style relative-position table lookup) is added to the
+        scores before the softmax. Runs per block, so no (T, T) bias is
+        ever materialized.
+
+    Returns: the attention output block ``(B, T_local, H, D)``, exactly
+    equal (up to float assoc.) to slicing full-sequence attention.
+
+    The K/V block makes ``axis_size`` hops around the ring; each step
+    contracts the local queries against one remote block and folds the
+    result into an online-softmax accumulator ``(m, l, o)`` — running max,
+    running normalizer, running unnormalized output — so no step ever
+    materializes the full (T, T) score matrix.
+    """
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    if q_offset is None:
+        q_offset = idx * T
+
+    q_pos = q_offset + jnp.arange(T)  # (T,) global query positions
+
+    def step(carry, _):
+        k_blk, v_blk, kv_idx, m, l, o = carry
+        s = _block_scores(q, k_blk, scale)  # (B, H, Tq, Tk)
+        kv_pos = kv_idx * T + jnp.arange(T)  # global key positions
+        if bias_fn is not None:
+            s = s + bias_fn(q_pos, kv_pos)
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]  # (Tq, Tk)
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))  # (B, H, Tq)
+        # exp(-inf - -inf) guards: where m_new is still -inf (no visible
+        # key yet), keep p at 0 and the correction factor at 1
+        corr = jnp.where(jnp.isneginf(m), jnp.where(jnp.isneginf(m_new),
+                                                    1.0, 0.0),
+                         jnp.exp(m - m_new))
+        p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0,
+                      jnp.exp(s - m_new[..., None]))
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+        # rotate K/V one hop around the ring (ICI neighbor traffic)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        kv_nxt = lax.ppermute(kv_idx, axis_name, perm)
+        return (k_nxt, v_nxt, kv_nxt, m_new, l, o), None
+
+    m0 = jnp.full((B, H, T), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, T), q.dtype)
+    o0 = jnp.zeros((B, H, T, D), q.dtype)
+    # mark the constant init as device-varying so the scan carry type
+    # matches its (axis-varying) outputs under shard_map's vma check
+    if hasattr(lax, "pcast"):
+        m0, l0, o0 = (lax.pcast(x, axis_name, to="varying")
+                      for x in (m0, l0, o0))
+    elif hasattr(lax, "pvary"):
+        m0, l0, o0 = (lax.pvary(x, axis_name) for x in (m0, l0, o0))
+    (_, _, _, m, l, o), _ = lax.scan(
+        step, (k, v, idx, m0, l0, o0), None, length=axis_size)
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # (B, H, Tq, D)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ulysses_all_to_all(x: jax.Array, axis_name: str,
+                       to_heads: bool = True) -> jax.Array:
+    """Ulysses reshard between sequence-split and head-split layouts.
+
+    With axis size N and per-shard ``(B, T_local, H, D)``:
+
+    * ``to_heads=True``: gather the FULL sequence for H/N heads —
+      returns ``(B, T_local * N, H // N, D)``. Any single-device attention
+      kernel then runs unchanged on its head slice.
+    * ``to_heads=False``: the inverse, back to ``(B, T_local, H, D)``.
+
+    Head count must divide the axis size's shard (H % N == 0). One
+    ``lax.all_to_all`` each way — the Ulysses communication pattern.
+    """
+    n = lax.psum(1, axis_name)
+    if to_heads:
+        H = x.shape[2]
+        if isinstance(n, int) and H % n != 0:
+            raise ValueError(
+                f"ulysses_all_to_all: head count {H} must divide the "
+                f"'{axis_name}' axis size {n}")
+        # split heads into N groups, exchange so each shard holds all T of
+        # one group: concat_axis=time, split_axis=heads
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False, bias_fn=None) -> jax.Array:
+    """Full-sequence single-device attention (the correctness oracle for
+    the parallel paths; also usable per head-slice after a Ulysses
+    reshard). Shapes ``(B, T, H, D)``."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    t = q.shape[1]
+    if bias_fn is not None:
+        pos = jnp.arange(t)
+        s = s + bias_fn(pos, pos)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v)
+    return jnp.einsum("bhqd->bqhd", out)

@@ -1,0 +1,132 @@
+"""Sequence-parallel correctness on the virtual 8-device mesh: ring
+attention and the Ulysses all-to-all reshard must reproduce full-sequence
+attention exactly (up to float association)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multiverso_tpu.parallel.ring import (reference_attention, ring_attention,
+                                          ulysses_all_to_all)
+
+
+def _mesh(n=8, name="sp"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _qkv(rng, B=2, T=64, H=4, D=16):
+    q = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    spec = P(None, "sp", None, None)  # sequence axis sharded
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    got = jax.jit(ring)(q, k, v)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_single_shard_degenerates():
+    """axis size 1: ring attention IS full attention."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, T=32)
+    spec = P(None, "sp", None, None)
+    got = jax.jit(shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_roundtrip_and_attention():
+    """all-to-all to head-split layout, run the ORACLE kernel per head
+    slice, reshard back — must equal full attention (the Ulysses scheme)."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _mesh(n=4)
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, T=32, H=8)  # H=8 divisible by axis 4
+    spec = P(None, "sp", None, None)
+
+    def ulysses_attn(q, k, v):
+        qh = ulysses_all_to_all(q, "sp", to_heads=True)
+        kh = ulysses_all_to_all(k, "sp", to_heads=True)
+        vh = ulysses_all_to_all(v, "sp", to_heads=True)
+        oh = reference_attention(qh, kh, vh)  # full T, H/4 heads locally
+        return ulysses_all_to_all(oh, "sp", to_heads=False)
+
+    got = jax.jit(shard_map(ulysses_attn, mesh=mesh,
+                            in_specs=(spec, spec, spec), out_specs=spec))(
+        q, k, v)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_long_sequence_memory_shape():
+    """The per-step score block is (B, H, T_local, T_local), never
+    (T, T): check via abstract evaluation that no intermediate of global
+    T x T size appears in the jaxpr shapes."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _mesh()
+    B, T, H, D = 1, 512, 2, 8  # global T=512, local 64
+    spec = P(None, "sp", None, None)
+    fn = jax.jit(shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp"),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    shaped = jax.ShapeDtypeStruct((B, T, H, D), jnp.float32)
+    # must trace/lower without materializing (T, T); execution smoke-checks
+    lowered = fn.lower(shaped, shaped, shaped)
+    assert "512,512" not in lowered.as_text()
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, B=B, T=T, H=H, D=D)
+    out = np.asarray(fn(q, k, v))
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(out, np.asarray(want), rtol=3e-5, atol=3e-5)
+
+
+def test_ring_attention_relative_bias_matches_full():
+    """The per-block bias hook (T5-style relative-position bias) must
+    produce the same result as adding the full (T, T) bias on one device —
+    global positions flow correctly through the ring rotation."""
+    from jax.experimental.shard_map import shard_map
+
+    mesh = _mesh()
+    rng = np.random.default_rng(4)
+    T, H = 64, 4
+    q, k, v = _qkv(rng, T=T, H=H)
+    rel = jnp.asarray(rng.normal(size=(H, 2 * T - 1)).astype(np.float32))
+
+    def bias_fn(q_pos, kv_pos):
+        d = q_pos[:, None] - kv_pos[None, :] + T - 1
+        return rel[:, d][None]
+
+    spec = P(None, "sp", None, None)
+    ring = jax.jit(shard_map(
+        lambda q, k, v: ring_attention(q, k, v, "sp", causal=True,
+                                       bias_fn=bias_fn),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+    got = np.asarray(ring(q, k, v))
+    want = np.asarray(reference_attention(q, k, v, causal=True,
+                                          bias_fn=bias_fn))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
