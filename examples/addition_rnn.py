@@ -76,7 +76,9 @@ def main(digits=2, hidden=128, n=20000, epochs=20, batch=128, lr=1e-3,
 
     rng = np.random.default_rng(seed)
     X, Y = make_dataset(n, digits, rng)
-    n_val = max(n // 10, 1)
+    # size the split from the ACTUAL dataset (make_dataset caps n at the
+    # number of distinct questions)
+    n_val = max(len(X) // 10, 1)
     Xv, Yv = X[:n_val], Y[:n_val]
     Xt, Yt = X[n_val:], Y[n_val:]
 

@@ -1,0 +1,196 @@
+"""Latent Dirichlet Allocation by block-stale collapsed Gibbs sampling on
+parameter-server tables — the lightLDA shape.
+
+Reference capability (not copied): the reference framework was built for
+exactly this workload class — "sparse high-dimensional models … the
+lightLDA/CTR shape" — with the word-topic count matrix living in a shared
+table that workers pull candidate rows from and push count deltas to
+(the WordEmbedding app's 5-table recipe is the same topology,
+``Applications/WordEmbedding/src/communicator.cpp:17-32``; DMTK's lightLDA
+was the flagship consumer of the sparse table machinery the LR app's
+``util/sparse_table.h`` demonstrates).
+
+TPU-native re-design: one Gibbs SWEEP over a block of documents is ONE
+jitted kernel — doc-topic counts are rebuilt in-kernel from the current
+assignments (one-hot einsum on the MXU), every token's conditional
+``(N_dk - self + α)(N_wk + β)/(N_k + Vβ)`` is evaluated in parallel, and
+new topics are drawn with the Gumbel-argmax trick (no host RNG in the
+loop). Tokens sample against the block-start table snapshot (the standard
+stale/Jacobi approximation every distributed LDA uses — lightLDA's tables
+were equally stale between syncs); the DOC-level exclusion is exact.
+Tables: word-topic counts = a row-sharded MatrixTable pulled by candidate
+rows (only the block's distinct words cross), topic totals = a tiny
+ArrayTable; both receive count DELTAS, so workers compose associatively
+like any PS app.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from multiverso_tpu import log
+
+
+class LDAConfig:
+    def __init__(self, vocab_size: int, num_topics: int, alpha: float = 0.5,
+                 beta: float = 0.1, seed: int = 0) -> None:
+        self.vocab_size = int(vocab_size)
+        self.num_topics = int(num_topics)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.seed = int(seed)
+
+
+def _make_sweep(config: LDAConfig):
+    """One block Gibbs sweep, jitted: (wt_rows (R, K), nk (K,), slots
+    (D, L) compact word-slot ids with -1 pad, z (D, L), key) ->
+    (z_new, d_wt (R, K), d_nk (K,), moved)."""
+    K = config.num_topics
+    alpha, beta = config.alpha, config.beta
+    v_beta = config.vocab_size * beta
+
+    def sweep(wt_rows, nk, slots, z, key):
+        live = slots >= 0
+        slot_safe = jnp.maximum(slots, 0)
+        zoh = jax.nn.one_hot(z, K, dtype=jnp.float32)
+        zoh = zoh * live[..., None]
+        doc_counts = zoh.sum(axis=1, keepdims=True)       # (D, 1, K)
+        n_dk_excl = doc_counts - zoh                      # exact self-excl
+        wt = wt_rows[slot_safe]                           # (D, L, K)
+        logp = (jnp.log(n_dk_excl + alpha)
+                + jnp.log(wt + beta)
+                - jnp.log(nk + v_beta))
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, logp.shape, minval=1e-10, maxval=1.0)))
+        z_new = jnp.where(live, jnp.argmax(logp + g, axis=-1), z)
+        znoh = jax.nn.one_hot(z_new, K, dtype=jnp.float32) * live[..., None]
+        # count deltas in the COMPACT row space (R rows): new - old
+        flat_slots = slot_safe.reshape(-1)
+        diff = (znoh - zoh).reshape(-1, K)
+        d_wt = jnp.zeros_like(wt_rows).at[flat_slots].add(diff)
+        d_nk = diff.sum(axis=0)
+        moved = (live & (z_new != z)).sum()
+        return z_new, d_wt, d_nk, moved
+
+    return jax.jit(sweep)
+
+
+class PSGibbsLDA:
+    """Block-parallel collapsed Gibbs LDA over shared tables.
+
+    ``docs`` is a list of int32 token arrays. Call :meth:`sweep` per
+    iteration; word-topic state lives in the tables, assignments ``z``
+    locally (lightLDA kept z local per worker the same way)."""
+
+    def __init__(self, config: LDAConfig, docs, pad_to: Optional[int] = None,
+                 tables=None) -> None:
+        """``tables=(word_topic, topic_counts)`` shares existing tables —
+        the multi-worker topology: each worker owns a doc shard and its
+        local ``z``, all push count deltas into the SAME tables (lightLDA's
+        data-parallel shape)."""
+        import multiverso_tpu as mv
+        self.config = config
+        self.rng = np.random.default_rng(config.seed)
+        K = config.num_topics
+        L = pad_to or max(len(d) for d in docs)
+        D = len(docs)
+        self.tokens = np.full((D, L), -1, np.int32)
+        for i, d in enumerate(docs):
+            if len(d) > L:
+                log.fatal("doc %d longer (%d) than pad_to %d", i, len(d), L)
+            self.tokens[i, : len(d)] = d
+        self.z = self.rng.integers(0, K, size=(D, L)).astype(np.int32)
+        self.z[self.tokens < 0] = 0
+
+        # shared state: word-topic matrix (candidate-row pulls) + totals
+        if tables is not None:
+            self.word_topic, self.topic_counts = tables
+        else:
+            self.word_topic = mv.create_table(
+                "matrix", config.vocab_size, K, np.float32)
+            self.topic_counts = mv.create_table("array", K, np.float32)
+
+        # seed the tables with the initial assignment counts (master push)
+        live = self.tokens >= 0
+        init_wt = np.zeros((config.vocab_size, K), np.float32)
+        np.add.at(init_wt, (self.tokens[live], self.z[live]), 1.0)
+        nz = np.nonzero(init_wt.any(axis=1))[0].astype(np.int32)
+        self.word_topic.add(init_wt[nz], row_ids=nz)
+        self.topic_counts.add(init_wt.sum(axis=0))
+
+        self._sweep = _make_sweep(config)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._device_io = getattr(self.word_topic, "supports_device_io",
+                                  False)
+
+    def sweep(self) -> int:
+        """One Gibbs sweep over every document block; returns how many
+        tokens changed topic (the mixing signal)."""
+        cfg = self.config
+        words = np.unique(self.tokens[self.tokens >= 0]).astype(np.int32)
+        # compact slot remap (candidate rows only — the PS contract)
+        lut = np.full(cfg.vocab_size, -1, np.int32)
+        lut[words] = np.arange(len(words), dtype=np.int32)
+        slots = np.where(self.tokens >= 0, lut[np.maximum(self.tokens, 0)],
+                         -1).astype(np.int32)
+
+        if self._device_io:
+            h = self.word_topic.get_device_async(words)
+            wt_rows = self.word_topic.wait_device(h, words)
+            nk = jnp.asarray(self.topic_counts.get())
+        else:
+            wt_rows = jnp.asarray(self.word_topic.get(words))
+            nk = jnp.asarray(self.topic_counts.get())
+
+        self._key, sub = jax.random.split(self._key)
+        z_new, d_wt, d_nk, moved = self._sweep(
+            wt_rows[:, : cfg.num_topics] if wt_rows.shape[1] != cfg.num_topics
+            else wt_rows,
+            nk, jnp.asarray(slots), jnp.asarray(self.z), sub)
+
+        # push deltas for the candidate rows only
+        d_wt_host = np.asarray(d_wt)[: len(words)]
+        self.word_topic.add(d_wt_host, row_ids=words)
+        self.topic_counts.add(np.asarray(d_nk))
+        self.z = np.asarray(z_new)
+        return int(moved)
+
+    def run(self, sweeps: int, verbose: bool = False) -> None:
+        for i in range(sweeps):
+            moved = self.sweep()
+            if verbose:
+                log.info("lda sweep %d: %d tokens moved", i + 1, moved)
+
+    # -- posterior summaries ------------------------------------------------
+    def word_topic_counts(self) -> np.ndarray:
+        return np.asarray(self.word_topic.get())[:, : self.config.num_topics]
+
+    def doc_topics(self) -> np.ndarray:
+        """Per-doc dominant topic from the local assignments."""
+        K = self.config.num_topics
+        live = self.tokens >= 0
+        counts = np.zeros((len(self.tokens), K), np.int64)
+        for k in range(K):
+            counts[:, k] = ((self.z == k) & live).sum(axis=1)
+        return counts.argmax(axis=1)
+
+
+def synthetic_corpus(vocab: int, topics: int, docs: int, doc_len: int,
+                     seed: int = 0, sharpness: float = 0.95):
+    """Planted-topic corpus: the vocab splits into ``topics`` equal word
+    clusters; each doc draws from one cluster with prob ``sharpness``.
+    Returns (docs list, true doc labels)."""
+    rng = np.random.default_rng(seed)
+    per = vocab // topics
+    labels = rng.integers(0, topics, size=docs)
+    out = []
+    for t in labels:
+        own = rng.random(doc_len) < sharpness
+        cluster = np.where(own, t, rng.integers(0, topics, size=doc_len))
+        toks = cluster * per + rng.integers(0, per, size=doc_len)
+        out.append(toks.astype(np.int32))
+    return out, labels

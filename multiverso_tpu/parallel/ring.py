@@ -26,7 +26,6 @@ against full-sequence attention on the virtual 8-device mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -131,16 +130,18 @@ def ulysses_all_to_all(x: jax.Array, axis_name: str,
       kernel then runs unchanged on its head slice.
     * ``to_heads=False``: the inverse, back to ``(B, T_local, H, D)``.
 
-    Head count must divide the axis size's shard (H % N == 0). One
-    ``lax.all_to_all`` each way — the Ulysses communication pattern.
+    The axis size must divide the head count (H % N == 0 — each shard
+    takes H/N heads). One ``lax.all_to_all`` each way — the Ulysses
+    communication pattern.
     """
     n = lax.psum(1, axis_name)
     if to_heads:
         H = x.shape[2]
         if isinstance(n, int) and H % n != 0:
             raise ValueError(
-                f"ulysses_all_to_all: head count {H} must divide the "
-                f"'{axis_name}' axis size {n}")
+                f"ulysses_all_to_all: the '{axis_name}' axis size {n} "
+                f"must divide the head count {H} (each shard takes "
+                f"H/{n} heads)")
         # split heads into N groups, exchange so each shard holds all T of
         # one group: concat_axis=time, split_axis=heads
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,

@@ -1,0 +1,120 @@
+"""LDA (lightLDA-shaped) on PS tables: block-stale collapsed Gibbs must
+recover planted topics, pulls must stay candidate-rows-only, and the
+count-delta invariants must hold sweep to sweep."""
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.models.lda import (LDAConfig, PSGibbsLDA,
+                                       synthetic_corpus)
+
+
+def _purity(pred, labels, k):
+    """Cluster purity of predicted doc topics vs planted labels."""
+    total = 0
+    for t in range(k):
+        members = labels[pred == t]
+        if len(members):
+            total += np.bincount(members, minlength=k).max()
+    return total / len(labels)
+
+
+def test_lda_recovers_planted_topics(mv_env):
+    vocab, topics = 60, 3
+    docs, labels = synthetic_corpus(vocab, topics, docs=60, doc_len=40,
+                                    seed=1)
+    cfg = LDAConfig(vocab, topics, alpha=0.5, beta=0.1, seed=1)
+    lda = PSGibbsLDA(cfg, docs)
+    lda.run(sweeps=20)
+    purity = _purity(lda.doc_topics(), labels, topics)
+    assert purity > 0.9, f"planted topics not recovered: purity={purity}"
+    # word-topic structure: words of one cluster concentrate on one topic
+    wt = lda.word_topic_counts()
+    per = vocab // topics
+    word_top = wt.argmax(axis=1)
+    word_purity = np.mean([
+        np.bincount(word_top[c * per:(c + 1) * per], minlength=topics).max()
+        / per for c in range(topics)])
+    assert word_purity > 0.8, f"word clusters not separated: {word_purity}"
+
+
+def test_lda_count_invariants(mv_env):
+    """Table counts must stay consistent with the local assignments after
+    every sweep: column sums of word-topic == topic totals, and the grand
+    total == number of live tokens (deltas compose associatively)."""
+    vocab, topics = 40, 4
+    docs, _ = synthetic_corpus(vocab, topics, docs=30, doc_len=25, seed=2)
+    cfg = LDAConfig(vocab, topics, seed=2)
+    lda = PSGibbsLDA(cfg, docs)
+    n_live = int(sum(len(d) for d in docs))
+    for _ in range(3):
+        lda.sweep()
+        wt = lda.word_topic_counts()
+        nk = lda.topic_counts.get()[: topics]
+        np.testing.assert_allclose(wt.sum(axis=0), nk, atol=1e-3)
+        assert abs(wt.sum() - n_live) < 1e-3
+        # table counts equal the counts implied by local z
+        live = lda.tokens >= 0
+        implied = np.zeros_like(wt)
+        np.add.at(implied, (lda.tokens[live], lda.z[live]), 1.0)
+        np.testing.assert_allclose(wt, implied, atol=1e-3)
+
+
+def test_lda_pulls_candidate_rows_only(mv_env):
+    """The sweep must pull exactly the block's distinct words — the PS
+    candidate-row contract (no O(V) transfer)."""
+    vocab, topics = 10_000, 3
+    # narrow corpus: only 90 distinct words appear
+    docs, _ = synthetic_corpus(90, topics, docs=20, doc_len=30, seed=3)
+    cfg = LDAConfig(vocab, topics, seed=3)
+    lda = PSGibbsLDA(cfg, docs)
+    before = lda.word_topic.rows_pulled
+    lda.sweep()
+    distinct = len(np.unique(lda.tokens[lda.tokens >= 0]))
+    assert lda.word_topic.rows_pulled - before == distinct
+    assert distinct <= 90
+
+
+def test_lda_two_workers_shared_tables():
+    """Two workers, disjoint doc shards, ONE pair of shared tables: the
+    combined counts must stay exact (delta pushes compose across workers)
+    and the planted topics must still be recovered jointly."""
+    import threading
+
+    vocab, topics = 60, 3
+    docs, labels = synthetic_corpus(vocab, topics, docs=60, doc_len=40,
+                                    seed=4)
+    mv.init(local_workers=2)
+    try:
+        cfg0 = LDAConfig(vocab, topics, seed=4)
+        shard0 = PSGibbsLDA(cfg0, docs[:30])
+        tables = (shard0.word_topic, shard0.topic_counts)
+        cfg1 = LDAConfig(vocab, topics, seed=5)
+        shard1 = PSGibbsLDA(cfg1, docs[30:], tables=tables)
+        shards = [shard0, shard1]
+
+        def run(slot):
+            with mv.worker(slot):
+                shards[slot].run(sweeps=20)
+
+        threads = [threading.Thread(target=run, args=(s,)) for s in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+
+        # combined table counts == counts implied by both shards' local z
+        wt = shard0.word_topic_counts()
+        implied = np.zeros_like(wt)
+        for s in shards:
+            live = s.tokens >= 0
+            np.add.at(implied, (s.tokens[live], s.z[live]), 1.0)
+        np.testing.assert_allclose(wt, implied, atol=1e-3)
+
+        pred = np.concatenate([shard0.doc_topics(), shard1.doc_topics()])
+        purity = _purity(pred, labels, topics)
+        assert purity > 0.85, f"joint topics not recovered: {purity}"
+    finally:
+        mv.shutdown()
