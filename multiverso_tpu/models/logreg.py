@@ -388,8 +388,60 @@ def parse_libsvm_line(line: str, max_nnz: int) -> Tuple[int, np.ndarray, np.ndar
     return label, idx, val
 
 
+def load_libsvm_native(path: str, max_nnz: int = 64
+                       ) -> Optional[Dict[str, np.ndarray]]:
+    """Native multithreaded libsvm parse (``native/text_reader.cpp`` — the
+    analog of the reference's C++ sample readers, reader.cpp). Returns
+    None when the .so isn't built or the parse fails; output is
+    byte-identical to the Python path (asserted by tests/test_lr_io.py)."""
+    import ctypes
+    import os
+
+    from multiverso_tpu.utils.quantization import _load_native
+    lib = _load_native()
+    if lib is None or not os.path.isfile(path):
+        return None
+
+    class _Result(ctypes.Structure):
+        _fields_ = [("n_rows", ctypes.c_longlong),
+                    ("max_nnz", ctypes.c_int),
+                    ("labels", ctypes.POINTER(ctypes.c_int)),
+                    ("indices", ctypes.POINTER(ctypes.c_int)),
+                    ("values", ctypes.POINTER(ctypes.c_float))]
+
+    try:
+        fn = lib.MVTR_ParseLibsvmFile
+    except AttributeError:
+        return None
+    fn.restype = ctypes.c_int
+    fn.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(_Result)]
+    lib.MVTR_FreeResult.argtypes = [ctypes.POINTER(_Result)]
+    res = _Result()
+    if fn(path.encode(), int(max_nnz), ctypes.byref(res)) != 0:
+        return None
+    try:
+        n = int(res.n_rows)
+        y = np.ctypeslib.as_array(res.labels, (n,)).astype(np.int32) \
+            if n else np.zeros(0, np.int32)
+        idx = (np.ctypeslib.as_array(res.indices, (n, max_nnz))
+               .astype(np.int32) if n
+               else np.full((0, max_nnz), -1, np.int32))
+        val = (np.ctypeslib.as_array(res.values, (n, max_nnz))
+               .astype(np.float32) if n
+               else np.zeros((0, max_nnz), np.float32))
+        return {"y": y, "idx": idx, "val": val}
+    finally:
+        lib.MVTR_FreeResult(ctypes.byref(res))
+
+
 def load_libsvm(path: str, max_nnz: int = 64) -> Dict[str, np.ndarray]:
-    """Load a LibSVM-format file into padded sparse batch arrays."""
+    """Load a LibSVM-format file into padded sparse batch arrays. Plain
+    local files take the native multithreaded parser when the .so is
+    built; stream URIs (mvfs://, gs://, mem://) use the Python path."""
+    if "://" not in path:
+        native = load_libsvm_native(path, max_nnz)
+        if native is not None:
+            return native
     from multiverso_tpu.io import TextReader
     labels, idxs, vals = [], [], []
     reader = TextReader(path)

@@ -291,3 +291,89 @@ def test_small_lr_not_raised_by_decay_floor():
     from multiverso_tpu.models.logreg import LogRegConfig, _effective_lr
     config = LogRegConfig(input_size=2, lr=5e-4)
     assert _effective_lr(config, 0, None) == 5e-4
+
+
+def test_native_libsvm_parser_matches_python(tmp_path):
+    """native/text_reader.cpp must be byte-identical to the Python parser
+    across the format's edge cases (value-less tokens, blank lines,
+    truncation at max_nnz, float labels, negative values)."""
+    import subprocess
+    from pathlib import Path
+
+    from multiverso_tpu.models.logreg import (load_libsvm,
+                                              load_libsvm_native,
+                                              parse_libsvm_line)
+
+    native_dir = Path(__file__).resolve().parent.parent / "multiverso_tpu" / "native"
+    subprocess.run(["make", "-C", str(native_dir)], check=True,
+                   capture_output=True)
+    # _load_native caches the FIRST dlopen attempt process-wide; an earlier
+    # test touching the wire codec before this build (or a stale .so) would
+    # otherwise pin None/an old handle regardless of the make above
+    from multiverso_tpu.utils import quantization
+    quantization._native = None
+    quantization._native_load_attempted = False
+
+    lines = [
+        "1 0:0.5 3:1.25 7:-2.0",
+        "",                          # blank: skipped
+        "0 2:0.1 4:0.2 5:0.3 6:0.4 8:0.5",   # truncates at max_nnz=4
+        "-1 1:1e-3 9:2.5E2",
+        "2.0 0:1",                   # float label -> int
+        "1 5: 6:2.0",                # value-less "5:" -> 1.0
+        "0 7",                       # bare feature -> 1.0
+        "   ",                       # whitespace-only: skipped
+        "3 1:0.25",
+    ]
+    path = tmp_path / "edge.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+
+    native = load_libsvm_native(str(path), max_nnz=4)
+    assert native is not None, "native parser unavailable after build"
+    # python reference path (force it by parsing line by line)
+    ys, idxs, vals = [], [], []
+    for line in lines:
+        if not line.strip():
+            continue
+        y, idx, val = parse_libsvm_line(line, 4)
+        ys.append(y)
+        idxs.append(idx)
+        vals.append(val)
+    np.testing.assert_array_equal(native["y"], np.array(ys, np.int32))
+    np.testing.assert_array_equal(native["idx"], np.stack(idxs))
+    np.testing.assert_array_equal(native["val"], np.stack(vals))
+    # the auto-dispatch path must agree on the edge-case file too
+    fast_edge = load_libsvm(str(path), max_nnz=4)
+    for key in ("y", "idx", "val"):
+        np.testing.assert_array_equal(fast_edge[key], native[key])
+
+    # larger randomized file: load_libsvm (auto fast path) == python rows
+    rng = np.random.default_rng(0)
+    big = []
+    for _ in range(500):
+        nnz = rng.integers(1, 9)
+        feats = sorted(rng.choice(100, nnz, replace=False))
+        toks = " ".join(f"{f}:{rng.normal():.6g}" for f in feats)
+        big.append(f"{rng.integers(0, 3)} {toks}")
+    bpath = tmp_path / "big.libsvm"
+    bpath.write_text("\n".join(big) + "\n")
+    fast = load_libsvm(str(bpath), max_nnz=8)
+    nat = load_libsvm_native(str(bpath), max_nnz=8)
+    ys2, idxs2, vals2 = [], [], []
+    for line in big:
+        y, idx, val = parse_libsvm_line(line, 8)
+        ys2.append(y); idxs2.append(idx); vals2.append(val)
+    np.testing.assert_array_equal(nat["y"], np.array(ys2, np.int32))
+    np.testing.assert_array_equal(nat["idx"], np.stack(idxs2))
+    np.testing.assert_allclose(nat["val"], np.stack(vals2), rtol=1e-6)
+    for key in ("y", "idx", "val"):
+        np.testing.assert_array_equal(fast[key], nat[key])
+
+    # malformed input must NOT silently succeed natively: the native call
+    # reports an error (None) and the dispatch falls back to the Python
+    # parser, which raises loudly — same observable behavior either way
+    bad = tmp_path / "bad.libsvm"
+    bad.write_text("1 3:abc 4:1.0\n")
+    assert load_libsvm_native(str(bad), max_nnz=4) is None
+    with pytest.raises(ValueError):
+        load_libsvm(str(bad), max_nnz=4)
