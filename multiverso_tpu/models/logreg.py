@@ -417,7 +417,9 @@ def load_libsvm_native(path: str, max_nnz: int = 64
     fn.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(_Result)]
     lib.MVTR_FreeResult.argtypes = [ctypes.POINTER(_Result)]
     res = _Result()
-    if fn(path.encode(), int(max_nnz), ctypes.byref(res)) != 0:
+    # os.fsencode: filenames with surrogate escapes (non-UTF-8 on-disk
+    # names) must round-trip, not raise UnicodeEncodeError
+    if fn(os.fsencode(path), int(max_nnz), ctypes.byref(res)) != 0:
         return None
     try:
         n = int(res.n_rows)
@@ -453,6 +455,10 @@ def load_libsvm(path: str, max_nnz: int = 64) -> Dict[str, np.ndarray]:
         idxs.append(idx)
         vals.append(val)
     reader.close()
+    if not labels:  # empty/all-blank file: same contract as the native path
+        return {"y": np.zeros(0, np.int32),
+                "idx": np.full((0, max_nnz), -1, np.int32),
+                "val": np.zeros((0, max_nnz), np.float32)}
     return {"y": np.array(labels, np.int32), "idx": np.stack(idxs),
             "val": np.stack(vals)}
 

@@ -15,6 +15,8 @@
 
 #include <atomic>
 #include <charconv>
+#include <climits>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -45,6 +47,27 @@ inline bool is_blank(const char* b, const char* e) {
   return skip_ws(b, e) == e;
 }
 
+// Joins already-started threads before any exception propagates: a
+// std::thread destroyed while joinable calls std::terminate, which would
+// abort the embedding host before MVTR_ParseLibsvmFile's catch(...) runs.
+struct ThreadBatch {
+  std::vector<std::thread> ts;
+  template <typename F>
+  void spawn(F&& f) {
+    try {
+      ts.emplace_back(std::forward<F>(f));
+    } catch (...) {
+      join_all();
+      throw;  // contained by the extern "C" catch, reported as an error
+    }
+  }
+  void join_all() {
+    for (auto& t : ts)
+      if (t.joinable()) t.join();
+  }
+  ~ThreadBatch() { join_all(); }
+};
+
 long long count_rows(const Chunk& c) {
   long long rows = 0;
   const char* p = c.begin;
@@ -69,9 +92,14 @@ bool parse_chunk(const Chunk& c, int max_nnz, int* labels, int* indices,
     const char* line_end = nl ? nl : c.end;
     if (!is_blank(p, line_end)) {
       const char* cursor = skip_ws(p, line_end);
-      float labelf;
+      double labelf;
       auto lr = std::from_chars(cursor, line_end, labelf);
       if (lr.ec != std::errc()) return false;  // int(float(tok)) raises
+      // nan/inf/out-of-int32-range: Python raises (ValueError/Overflow);
+      // a raw cast would be UB — fail so the caller takes the loud path
+      if (!std::isfinite(labelf) || labelf >= 2147483648.0 ||
+          labelf < -2147483648.0)
+        return false;
       labels[row] = static_cast<int>(labelf);
       cursor = lr.ptr;
       int* idx = indices + row * max_nnz;
@@ -90,8 +118,13 @@ bool parse_chunk(const Chunk& c, int max_nnz, int* labels, int* indices,
           // "k:" with nothing (or whitespace) next -> 1.0, like the
           // Python `float(v) if v else 1.0` after partition(":")
           if (cursor < line_end && !is_ws(*cursor)) {
-            auto vr = std::from_chars(cursor, line_end, v);
+            // parse as DOUBLE then narrow: Python computes
+            // float32(float64(token)), and from_chars<float> can differ
+            // from that double-rounding path by 1 ulp
+            double vd;
+            auto vr = std::from_chars(cursor, line_end, vd);
             if (vr.ec != std::errc()) return false;  // float("abc") raises
+            v = static_cast<float>(vd);
             cursor = vr.ptr;
           }
         }
@@ -146,10 +179,10 @@ int parse_impl(const char* path, int max_nnz, MVTRResult* out) {
   }
 
   {  // count pass (parallel)
-    std::vector<std::thread> ts;
+    ThreadBatch ts;
     for (auto& c : chunks)
-      ts.emplace_back([&c] { c.rows = count_rows(c); });
-    for (auto& t : ts) t.join();
+      ts.spawn([&c] { c.rows = count_rows(c); });
+    ts.join_all();
   }
   long long total = 0;
   for (auto& c : chunks) {
@@ -174,14 +207,14 @@ int parse_impl(const char* path, int max_nnz, MVTRResult* out) {
 
   std::atomic<bool> ok{true};
   {  // parse pass (parallel; disjoint output ranges per chunk)
-    std::vector<std::thread> ts;
+    ThreadBatch ts;
     for (auto& c : chunks)
-      ts.emplace_back([&c, max_nnz, out, &ok] {
+      ts.spawn([&c, max_nnz, out, &ok] {
         if (!parse_chunk(c, max_nnz, out->labels, out->indices,
                          out->values))
           ok.store(false, std::memory_order_relaxed);
       });
-    for (auto& t : ts) t.join();
+    ts.join_all();
   }
   if (!ok.load()) {
     MVTR_FreeResult(out);

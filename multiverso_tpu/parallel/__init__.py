@@ -1,0 +1,12 @@
+"""Parallelism substrate: device meshes/shardings (:mod:`.mesh`) and
+sequence/context parallelism (:mod:`.ring` — ring attention + Ulysses)."""
+
+from multiverso_tpu.parallel.mesh import (build_mesh, parse_mesh_shape,
+                                          replicated, table_sharding)
+from multiverso_tpu.parallel.ring import (reference_attention, ring_attention,
+                                          ulysses_all_to_all)
+
+__all__ = [
+    "build_mesh", "parse_mesh_shape", "replicated", "table_sharding",
+    "reference_attention", "ring_attention", "ulysses_all_to_all",
+]

@@ -365,7 +365,9 @@ def test_native_libsvm_parser_matches_python(tmp_path):
         ys2.append(y); idxs2.append(idx); vals2.append(val)
     np.testing.assert_array_equal(nat["y"], np.array(ys2, np.int32))
     np.testing.assert_array_equal(nat["idx"], np.stack(idxs2))
-    np.testing.assert_allclose(nat["val"], np.stack(vals2), rtol=1e-6)
+    # exact: the native path parses double-then-narrows like Python's
+    # float32(float64(token)), so values are bit-identical
+    np.testing.assert_array_equal(nat["val"], np.stack(vals2))
     for key in ("y", "idx", "val"):
         np.testing.assert_array_equal(fast[key], nat[key])
 
@@ -377,3 +379,27 @@ def test_native_libsvm_parser_matches_python(tmp_path):
     assert load_libsvm_native(str(bad), max_nnz=4) is None
     with pytest.raises(ValueError):
         load_libsvm(str(bad), max_nnz=4)
+
+
+def test_libsvm_edge_contracts(tmp_path):
+    """Contract parity regardless of the .so: empty files return empty
+    arrays on both paths; nan/overflow labels fail the native parse (the
+    dispatch then raises through the Python path)."""
+    from multiverso_tpu.models.logreg import load_libsvm, load_libsvm_native
+
+    empty = tmp_path / "empty.libsvm"
+    empty.write_text("\n  \n")
+    via_dispatch = load_libsvm(str(empty), max_nnz=4)
+    assert via_dispatch["y"].shape == (0,)
+    assert via_dispatch["idx"].shape == (0, 4)
+    nat = load_libsvm_native(str(empty), max_nnz=4)
+    if nat is not None:  # .so built
+        for key in ("y", "idx", "val"):
+            np.testing.assert_array_equal(nat[key], via_dispatch[key])
+
+    bad_label = tmp_path / "nanlabel.libsvm"
+    bad_label.write_text("nan 1:0.5\n")
+    assert load_libsvm_native(str(bad_label), max_nnz=4) is None
+    overflow = tmp_path / "big.libsvm"
+    overflow.write_text("4000000000 1:0.5\n")
+    assert load_libsvm_native(str(overflow), max_nnz=4) is None
