@@ -24,6 +24,26 @@ import numpy as np
 import multiverso_tpu as mv
 
 
+def admin_seed(table, flat=None):
+    """Master-seed a freshly created table and read its settled value, all
+    as ADMINISTRATIVE (un-clocked) traffic. Setup must not be charged to a
+    worker's round budget: under BSP an unbound thread defaults to slot 0
+    and a gated Get would wedge the round gate before training starts.
+    Master-ness is decided BEFORE entering admin (inside, the thread has
+    no worker identity at all). ``flat=None`` skips the seeding add (the
+    table already carries state)."""
+    from multiverso_tpu.runtime.zoo import Zoo
+    zoo = Zoo.instance()
+    is_master = mv.is_master_worker()
+    with zoo.admin():
+        if flat is not None and is_master:
+            table.add(flat)
+        # seed must be visible before the first pull; process-level barrier
+        # (a per-worker mv.barrier() would deadlock single-caller setup)
+        zoo.process_barrier()
+        return table.get()
+
+
 class ParamManager:
     """Base manager. Subclasses implement :meth:`get_all_param_values` /
     :meth:`set_all_param_values` over lists of numpy arrays
@@ -40,19 +60,7 @@ class ParamManager:
         # master-only Add into a zero table: shard-consistent under
         # multi-process SPMD (see sharedvar.py seeding note)
         self._table = mv.create_table("array", flat.size, np.float32)
-        from multiverso_tpu.runtime.zoo import Zoo
-        zoo = Zoo.instance()
-        # setup traffic is administrative: seeding must not be charged to a
-        # worker's round budget (under BSP an unbound thread defaults to
-        # slot 0 and its gated Get would wedge before rounds ever start).
-        # Master-ness is decided BEFORE entering admin (inside, the thread
-        # has no worker identity at all).
-        is_master = mv.is_master_worker()
-        with zoo.admin():
-            if is_master:
-                self._table.add(flat)
-            zoo.process_barrier()
-            self._last_synced = self._table.get()
+        self._last_synced = admin_seed(self._table, flat)
         self._set_from_flat(self._last_synced)
 
     # -- subclass surface ---------------------------------------------------

@@ -29,21 +29,20 @@ class SharedArray:
         value = np.asarray(value, dtype=dtype)
         self._shape = value.shape
         self._dtype = value.dtype
+        from multiverso_tpu.ext.param_manager import admin_seed
         if table is None:
             # seed via a master-only Add into a zero table (the reference's
             # scheme, sharedvar.py:24-25): under multi-process SPMD every
             # process materializes identical zero shards, then exactly one
             # worker's delta lands — a per-process init_value would leave
-            # non-master hosts' shards zeroed
+            # non-master hosts' shards zeroed. admin_seed runs it un-clocked
+            # (BSP-safe) and settles the initial value.
             table = mv.create_table("array", value.size, self._dtype)
-            if mv.is_master_worker():
-                table.add(value.reshape(-1))
+            initial = admin_seed(table, value.reshape(-1))
+        else:
+            initial = admin_seed(table)
         self._table = table
-        # seed must be visible before the first pull; process-level barrier
-        # (a per-worker mv.barrier() would deadlock single-caller construction)
-        from multiverso_tpu.runtime.zoo import Zoo
-        Zoo.instance().process_barrier()
-        self._last_synced = self._table.get().reshape(self._shape)
+        self._last_synced = initial.reshape(self._shape)
         self._value = self._last_synced.copy()
 
     @property

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from multiverso_tpu import log
+from multiverso_tpu.dashboard import monitor
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime.zoo import Zoo
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
@@ -290,7 +291,8 @@ class MatrixServer(ServerTable):
         tables = [self] + list(others)
         datas = [t.data for t in tables]
         states = [t.states for t in tables]
-        out = fn(datas, states, *args)
+        with monitor("SERVER_PROCESS_TRANSACT"):
+            out = fn(datas, states, *args)
         try:
             new_datas, new_states, extra = out
             if (len(new_datas) != len(tables)

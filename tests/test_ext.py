@@ -127,3 +127,33 @@ def test_callback_sync_frequency(mv_env):
     assert cm.syncs == 2  # batches 0 and 2
     cb.on_epoch_end(0)
     assert cm.syncs == 3
+
+
+def test_shared_array_construction_under_bsp():
+    """SharedArray seeding from an unbound thread must not be charged to
+    worker 0's round budget (it would wedge the BSP gate before any round
+    starts) — the same admin-context contract as ParamManager. Runs in a
+    thread with a join timeout so a regression FAILS instead of hanging
+    the suite."""
+    import threading
+
+    import numpy as np
+
+    mv.init(sync=True, local_workers=2)
+    try:
+        from multiverso_tpu.ext import SharedArray
+
+        result = {}
+
+        def build():
+            sv = SharedArray(np.arange(6, dtype=np.float32).reshape(2, 3))
+            result["value"] = np.asarray(sv.value)
+
+        t = threading.Thread(target=build, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        assert not t.is_alive(), "SharedArray seeding wedged the BSP gate"
+        np.testing.assert_allclose(
+            result["value"], np.arange(6, dtype=np.float32).reshape(2, 3))
+    finally:
+        mv.shutdown()

@@ -293,26 +293,31 @@ def test_small_lr_not_raised_by_decay_floor():
     assert _effective_lr(config, 0, None) == 5e-4
 
 
+def _ensure_native():
+    """Build the .so and reset the process-wide loader cache (an earlier
+    test touching the wire codec before the build would otherwise pin a
+    None/stale handle)."""
+    import subprocess
+    from pathlib import Path
+
+    native_dir = (Path(__file__).resolve().parent.parent / "multiverso_tpu"
+                  / "native")
+    subprocess.run(["make", "-C", str(native_dir)], check=True,
+                   capture_output=True)
+    from multiverso_tpu.utils import quantization
+    quantization._native = None
+    quantization._native_load_attempted = False
+
+
 def test_native_libsvm_parser_matches_python(tmp_path):
     """native/text_reader.cpp must be byte-identical to the Python parser
     across the format's edge cases (value-less tokens, blank lines,
     truncation at max_nnz, float labels, negative values)."""
-    import subprocess
-    from pathlib import Path
-
     from multiverso_tpu.models.logreg import (load_libsvm,
                                               load_libsvm_native,
                                               parse_libsvm_line)
 
-    native_dir = Path(__file__).resolve().parent.parent / "multiverso_tpu" / "native"
-    subprocess.run(["make", "-C", str(native_dir)], check=True,
-                   capture_output=True)
-    # _load_native caches the FIRST dlopen attempt process-wide; an earlier
-    # test touching the wire codec before this build (or a stale .so) would
-    # otherwise pin None/an old handle regardless of the make above
-    from multiverso_tpu.utils import quantization
-    quantization._native = None
-    quantization._native_load_attempted = False
+    _ensure_native()
 
     lines = [
         "1 0:0.5 3:1.25 7:-2.0",
@@ -403,3 +408,56 @@ def test_libsvm_edge_contracts(tmp_path):
     overflow = tmp_path / "big.libsvm"
     overflow.write_text("4000000000 1:0.5\n")
     assert load_libsvm_native(str(overflow), max_nnz=4) is None
+
+
+def test_native_libsvm_parser_fuzz_equivalence(tmp_path):
+    """Seeded fuzz: random well-formed lines drawn from the format's
+    grammar (varied whitespace runs, value-less and bare tokens, float
+    labels, scientific notation, truncation) must parse identically on
+    both paths."""
+    from multiverso_tpu.models.logreg import (load_libsvm_native,
+                                              parse_libsvm_line)
+
+    _ensure_native()
+    rng = np.random.default_rng(42)
+    max_nnz = 6
+
+    def token(f):
+        r = rng.random()
+        if r < 0.15:
+            return str(f)            # bare feature -> 1.0
+        if r < 0.25:
+            return f"{f}:"           # value-less -> 1.0
+        if r < 0.45:
+            return f"{f}:{rng.normal():.8e}"  # scientific
+        if r < 0.6:
+            return f"{f}:{rng.integers(-9, 9)}"
+        return f"{f}:{rng.normal():.6f}"
+
+    lines = []
+    for _ in range(300):
+        label = rng.choice(["0", "1", "-1", "2.0", "3.75"])
+        nnz = int(rng.integers(0, 10))
+        feats = rng.choice(1000, size=nnz, replace=False)
+        ws = lambda: " " * int(rng.integers(1, 4)) + (
+            "\t" if rng.random() < 0.2 else "")
+        body = "".join(ws() + token(f) for f in feats)
+        lines.append(f"{label}{body}" + (" " if rng.random() < 0.3 else ""))
+        if rng.random() < 0.1:
+            lines.append("")  # blank
+    path = tmp_path / "fuzz.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+
+    nat = load_libsvm_native(str(path), max_nnz=max_nnz)
+    assert nat is not None
+    ys, idxs, vals = [], [], []
+    for line in lines:
+        if not line.strip():
+            continue
+        y, idx, val = parse_libsvm_line(line, max_nnz)
+        ys.append(y)
+        idxs.append(idx)
+        vals.append(val)
+    np.testing.assert_array_equal(nat["y"], np.array(ys, np.int32))
+    np.testing.assert_array_equal(nat["idx"], np.stack(idxs))
+    np.testing.assert_array_equal(nat["val"], np.stack(vals))
