@@ -39,7 +39,7 @@ def main(seq=256, lag=None, dim=64, heads=4, vocab=32, batch=8,
     import jax
     import jax.numpy as jnp
     import optax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import multiverso_tpu as mv

@@ -1142,17 +1142,22 @@ class PSTrainer:
         if lut is None:
             lut = self._slot_lut = np.full(self.config.vocab_size, -1,
                                            np.int32)
-        lut[blk_u] = np.arange(n_blk, dtype=np.int32)
-        pool_only = np.unique(draws[lut[draws] < 0]).astype(np.int32)
-        lut[pool_only] = n_blk + np.arange(len(pool_only), dtype=np.int32)
-        ids_out = np.concatenate([blk_u, pool_only])
-        slot_alias = lut[draws]
-        flat = lut[block]
-        # reset IMMEDIATELY (pure numpy since the fill — nothing can raise
-        # in between): a dirty persistent lut would silently map the next
-        # block's draws onto THIS block's compact slots
-        lut[blk_u] = -1
-        lut[pool_only] = -1
+        # reset in ``finally``: the numpy allocations between fill and
+        # reset can raise (MemoryError), and a dirty persistent lut would
+        # silently map the next block's draws onto THIS block's slots
+        pool_only = None
+        try:
+            lut[blk_u] = np.arange(n_blk, dtype=np.int32)
+            pool_only = np.unique(draws[lut[draws] < 0]).astype(np.int32)
+            lut[pool_only] = n_blk + np.arange(len(pool_only),
+                                               dtype=np.int32)
+            ids_out = np.concatenate([blk_u, pool_only])
+            slot_alias = lut[draws]
+            flat = lut[block]
+        finally:
+            lut[blk_u] = -1
+            if pool_only is not None:
+                lut[pool_only] = -1
 
         use_txn = self._can_transact()
         if not use_txn:

@@ -47,6 +47,17 @@ inline bool is_blank(const char* b, const char* e) {
   return skip_ws(b, e) == e;
 }
 
+// std::from_chars rejects a leading '+', but Python's int()/float()
+// accept one ('+1' is the canonical libsvm positive-label spelling).
+// Skip it only when a digit or '.' follows so '++1'/'+-1' still fail
+// the native parse and fall back to the loud Python path.
+inline const char* skip_plus(const char* p, const char* e) {
+  if (p + 1 < e && *p == '+' &&
+      ((p[1] >= '0' && p[1] <= '9') || p[1] == '.'))
+    return p + 1;
+  return p;
+}
+
 // Joins already-started threads before any exception propagates: a
 // std::thread destroyed while joinable calls std::terminate, which would
 // abort the embedding host before MVTR_ParseLibsvmFile's catch(...) runs.
@@ -91,7 +102,7 @@ bool parse_chunk(const Chunk& c, int max_nnz, int* labels, int* indices,
         memchr(p, '\n', static_cast<size_t>(c.end - p)));
     const char* line_end = nl ? nl : c.end;
     if (!is_blank(p, line_end)) {
-      const char* cursor = skip_ws(p, line_end);
+      const char* cursor = skip_plus(skip_ws(p, line_end), line_end);
       double labelf;
       auto lr = std::from_chars(cursor, line_end, labelf);
       if (lr.ec != std::errc()) return false;  // int(float(tok)) raises
@@ -108,6 +119,7 @@ bool parse_chunk(const Chunk& c, int max_nnz, int* labels, int* indices,
       while (k < max_nnz) {
         cursor = skip_ws(cursor, line_end);
         if (cursor >= line_end) break;
+        cursor = skip_plus(cursor, line_end);
         int feature;
         auto fr = std::from_chars(cursor, line_end, feature);
         if (fr.ec != std::errc()) return false;  // int(k) raises
@@ -121,6 +133,7 @@ bool parse_chunk(const Chunk& c, int max_nnz, int* labels, int* indices,
             // parse as DOUBLE then narrow: Python computes
             // float32(float64(token)), and from_chars<float> can differ
             // from that double-rounding path by 1 ulp
+            cursor = skip_plus(cursor, line_end);
             double vd;
             auto vr = std::from_chars(cursor, line_end, vd);
             if (vr.ec != std::errc()) return false;  // float("abc") raises

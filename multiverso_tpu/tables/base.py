@@ -153,8 +153,12 @@ class ServerTable:
         # repeated AddOption envelope (fixed-lr hot paths) hits the cache
         # and skips two host->device transfers per add; a churning
         # envelope (per-block lr decay) misses but cannot pin more than
-        # _OPT_CACHE_MAX dead device buffers.
+        # _OPT_CACHE_MAX dead device buffers. Locked: the dispatcher
+        # thread (process_add) and worker threads (the word2vec txn path)
+        # both call _option_consts, and a concurrent move_to_end on a key
+        # being popitem'd can raise KeyError.
         self._opt_cache: "OrderedDict" = OrderedDict()
+        self._opt_cache_lock = threading.Lock()
 
     _OPT_CACHE_MAX = 256
 
@@ -164,17 +168,21 @@ class ServerTable:
         ``self.num_workers``."""
         import jax.numpy as jnp
         key = (option.scalars(), int(option.worker_id))
-        cached = self._opt_cache.get(key)
-        if cached is None:
-            scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
-            worker = jnp.int32(max(option.worker_id, 0)
-                               % max(1, self.num_workers))
-            cached = (worker, scalars)
+        with self._opt_cache_lock:
+            cached = self._opt_cache.get(key)
+            if cached is not None:
+                self._opt_cache.move_to_end(key)
+                return cached
+        # build device constants OUTSIDE the lock (host->device upload);
+        # a racing duplicate insert is harmless — last writer wins
+        scalars = jnp.asarray(option.scalars(), dtype=jnp.float32)
+        worker = jnp.int32(max(option.worker_id, 0)
+                           % max(1, self.num_workers))
+        cached = (worker, scalars)
+        with self._opt_cache_lock:
             self._opt_cache[key] = cached
             if len(self._opt_cache) > self._OPT_CACHE_MAX:
                 self._opt_cache.popitem(last=False)
-        else:
-            self._opt_cache.move_to_end(key)
         return cached
 
     def remote_spec(self) -> Optional[Dict[str, Any]]:

@@ -329,6 +329,8 @@ def test_native_libsvm_parser_matches_python(tmp_path):
         "0 7",                       # bare feature -> 1.0
         "   ",                       # whitespace-only: skipped
         "3 1:0.25",
+        "+1 0:+0.5 2:+.25 3:+1e2",   # canonical '+1' label, '+' values
+        "+2.5 +4:+3",                # '+' float label, '+' feature id
     ]
     path = tmp_path / "edge.libsvm"
     path.write_text("\n".join(lines) + "\n")
@@ -385,6 +387,15 @@ def test_native_libsvm_parser_matches_python(tmp_path):
     with pytest.raises(ValueError):
         load_libsvm(str(bad), max_nnz=4)
 
+    # '+' forms Python rejects must also fail the native parse (skip_plus
+    # only swallows a '+' that a digit or '.' follows)
+    for badplus in ("++1 0:0.5", "+-1 0:0.5", "1 0:++2"):
+        bp = tmp_path / "badplus.libsvm"
+        bp.write_text(badplus + "\n")
+        assert load_libsvm_native(str(bp), max_nnz=4) is None
+        with pytest.raises(ValueError):
+            load_libsvm(str(bp), max_nnz=4)
+
 
 def test_libsvm_edge_contracts(tmp_path):
     """Contract parity regardless of the .so: empty files return empty
@@ -430,13 +441,15 @@ def test_native_libsvm_parser_fuzz_equivalence(tmp_path):
             return f"{f}:"           # value-less -> 1.0
         if r < 0.45:
             return f"{f}:{rng.normal():.8e}"  # scientific
-        if r < 0.6:
+        if r < 0.55:
+            return f"{f}:+{abs(rng.normal()):.6f}"  # '+'-prefixed value
+        if r < 0.65:
             return f"{f}:{rng.integers(-9, 9)}"
         return f"{f}:{rng.normal():.6f}"
 
     lines = []
     for _ in range(300):
-        label = rng.choice(["0", "1", "-1", "2.0", "3.75"])
+        label = rng.choice(["0", "1", "-1", "2.0", "3.75", "+1", "+0.5"])
         nnz = int(rng.integers(0, 10))
         feats = rng.choice(1000, size=nnz, replace=False)
         ws = lambda: " " * int(rng.integers(1, 4)) + (

@@ -25,7 +25,7 @@ def _qkv(rng, B=2, T=64, H=4, D=16):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(causal):
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = _mesh()
     rng = np.random.default_rng(0)
@@ -43,7 +43,7 @@ def test_ring_attention_matches_full(causal):
 
 def test_ring_attention_single_shard_degenerates():
     """axis size 1: ring attention IS full attention."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
     rng = np.random.default_rng(1)
@@ -60,7 +60,7 @@ def test_ring_attention_single_shard_degenerates():
 def test_ulysses_roundtrip_and_attention():
     """all-to-all to head-split layout, run the ORACLE kernel per head
     slice, reshard back — must equal full attention (the Ulysses scheme)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = _mesh(n=4)
     rng = np.random.default_rng(2)
@@ -90,7 +90,7 @@ def test_ring_attention_long_sequence():
     (shard_map bodies lower with global-shaped types), so this test pins
     the numerics at a T large enough that a full-matrix regression would
     also show up as a 64x score-memory blowup in profiling."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = _mesh()
     B, T, H, D = 1, 512, 2, 8  # global T=512, local 64
@@ -109,7 +109,7 @@ def test_ring_attention_relative_bias_matches_full():
     """The per-block bias hook (T5-style relative-position bias) must
     produce the same result as adding the full (T, T) bias on one device —
     global positions flow correctly through the ring rotation."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = _mesh()
     rng = np.random.default_rng(4)
