@@ -85,6 +85,12 @@ def barrier() -> None:
     Zoo.instance().barrier()
 
 
+def process_barrier() -> None:
+    """Cross-process rendezvous: real under a multi-process (multihost)
+    mesh, a no-op single-process."""
+    Zoo.instance().process_barrier()
+
+
 # -- identity ---------------------------------------------------------------
 
 def rank() -> int:
@@ -251,8 +257,8 @@ def create_table(kind: str, *args: Any, **kwargs: Any):
     except KeyError:
         log.fatal("unknown table kind %r (have: %s)", kind, sorted(_TABLE_TYPES))
     table = cls(*args, **kwargs)
-    # table creation happens once per process; sync processes, not local workers
-    Zoo.instance().process_barrier()
+    # table creation happens once per process and is collective under a
+    # multihost mesh — Zoo.register_table already rendezvoused processes
     return table
 
 

@@ -51,14 +51,29 @@ def read_array(stream: mv_io.Stream) -> np.ndarray:
     return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
 
 
+def _require_leader(verb: str) -> None:
+    """Multihost: snapshot/restore drive from the leader only — a follower
+    calling a raw table's store/load would run the device->host collective
+    OUTSIDE the lockstep replay stream and desynchronize the mesh. The
+    leader's lockstep wrapper broadcasts the collective to followers."""
+    from multiverso_tpu.runtime.zoo import Zoo
+    zoo = Zoo.instance()
+    if zoo.multihost is not None and zoo.rank != 0:
+        log.fatal("checkpoint %s must run on the multihost leader (rank 0);"
+                  " this is rank %d — followers participate via lockstep "
+                  "replay automatically", verb, zoo.rank)
+
+
 def store_table(table, address: str) -> None:
     """Store one table (worker or server handle) to a URI."""
+    _require_leader("snapshot")
     server = getattr(table, "_server_table", table)
     with mv_io.get_stream(address, "w") as stream:
         server.store(stream)
 
 
 def load_table(table, address: str) -> None:
+    _require_leader("restore")
     server = getattr(table, "_server_table", table)
     with mv_io.get_stream(address, "r") as stream:
         server.load(stream)

@@ -162,6 +162,11 @@ define_int("allocator_alignment", 16, "host buffer alignment (native allocator)"
 define_string("allocator_type", "smart", "host allocator: smart|default")
 define_string("machine_file", "", "multi-host machine list (external transport)")
 define_int("port", 55555, "external transport port")
+define_string("multihost_endpoint", "",
+              "host:port the leader (JAX process 0) binds for the multihost "
+              "lockstep control plane; same value on every process")
+define_double("multihost_timeout", 120.0,
+              "multihost control-plane connect/barrier timeout (seconds)")
 define_string("mesh_shape", "", "device mesh shape, e.g. '2x4'; empty = auto 1-D")
 define_string("mesh_axes", "server", "comma-separated mesh axis names")
 define_bool("deterministic", False,

@@ -44,9 +44,16 @@ void EnsureInterpreter() {
 }
 
 // RAII GIL hold valid for both embedded and host-owned interpreters.
+// Bootstraps the embedded interpreter first: FFI hosts legitimately call
+// flag/identity entry points BEFORE MV_Init (the Lua binding's
+// mv.set_flag), and PyGILState_Ensure on an uninitialized interpreter is
+// a crash (found by native/test_lua_ffi.c).
 class Gil {
  public:
-  Gil() : state_(PyGILState_Ensure()) {}
+  Gil() {
+    EnsureInterpreter();
+    state_ = PyGILState_Ensure();
+  }
   ~Gil() { PyGILState_Release(state_); }
 
  private:

@@ -26,6 +26,7 @@ class MsgType(enum.IntEnum):
     # server-bound requests (positive, < 32)
     Request_Get = 1
     Request_Add = 2
+    Server_Execute = 30  # run a callable on the dispatcher thread (admin)
     Server_Finish_Train = 31
     # worker-bound replies (negative)
     Reply_Get = -1

@@ -1,0 +1,675 @@
+"""Cross-process (multi-host) lockstep PS runtime.
+
+Reference capability (not copied): the reference scaled its parameter
+server by adding MPI/ZMQ ranks — tables were range-sharded across server
+ranks, each running its own Server actor, and ``RegisterNode`` grew the
+membership (``src/zoo.cpp:73-145``, ``include/multiverso/net/mpi_net.h``).
+
+TPU-native re-design: the table mesh spans every JAX process's devices
+(multi-controller SPMD under ``jax.distributed``); ONE jitted op updates
+the whole globally-sharded table and XLA's collectives move the bytes
+over ICI/DCN. What MPI message ordering did for the reference, LOCKSTEP
+REPLAY does here: rank 0 (the leader) runs the real dispatcher
+(async / BSP / deterministic — all consistency logic lives there only)
+and broadcasts each device-executing request descriptor over a tiny TCP
+control plane; follower ranks replay the identical stream, so every
+process issues the same collective program in the same order — the
+multi-controller contract. Control traffic is ids + host payloads; table
+bytes never cross TCP.
+
+Completion routing:
+
+* follower worker GETs complete at REPLAY time on the origin rank with
+  the locally-materialized (replicated-out) result — the payload rides
+  ICI, not TCP;
+* follower worker ADDs complete via a small ``ack`` from the leader at
+  whatever point the leader's server semantics complete them (enqueue
+  for deferred-apply servers, apply otherwise), preserving each server
+  type's contract.
+
+Request payloads must be host data (numpy / options); the device-IO fast
+paths are in-process-only and are disabled on every rank in multihost
+mode (``supports_device_io`` is False on the table proxies).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from multiverso_tpu import config, log
+from multiverso_tpu.runtime.message import Message, MsgType
+
+# flags: multihost_endpoint / multihost_timeout (defined in config.py so
+# they exist before this module is first imported)
+
+_LEN = struct.Struct("<q")
+
+
+def _send_obj(sock: socket.socket, lock: threading.Lock, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_obj(sock: socket.socket) -> Any:
+    header = _read_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    n = _LEN.unpack(header)[0]
+    body = _read_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Forwarded:
+    """A follower-origin request riding through the leader's server: the
+    origin/msg_id pair travels WITH the request so deferred servers
+    (BSP/deterministic) keep it attached through their pending queues and
+    the lockstep wrapper can stamp it onto the broadcast descriptor."""
+
+    __slots__ = ("origin", "msg_id", "request")
+
+    def __init__(self, origin: int, msg_id: int, request: Any) -> None:
+        self.origin = origin
+        self.msg_id = msg_id
+        self.request = request
+
+
+class _ForwardCompletion:
+    """Leader-side completion for a follower-origin request.
+
+    ADDs ack over TCP at the moment the leader's server completes them —
+    enqueue-time for deferred-apply servers, apply-time otherwise — so
+    each server type's add contract survives the process hop. GET
+    results are NOT shipped: the origin rank materializes the identical
+    value itself when it replays the op (data rides ICI)."""
+
+    __slots__ = ("_runtime", "_origin", "_msg_id", "_is_add")
+
+    def __init__(self, runtime: "MultihostRuntime", origin: int,
+                 msg_id: int, is_add: bool) -> None:
+        self._runtime = runtime
+        self._origin = origin
+        self._msg_id = msg_id
+        self._is_add = is_add
+
+    def done(self, result: Any) -> None:
+        if not self._is_add:
+            return  # origin completes at replay with the local result
+        if result is not None and not _is_host_payload(result):
+            log.error("multihost: dropping non-host fused add reply "
+                      "(device payloads cannot cross the control plane)")
+            result = None
+        self._runtime._send_to(self._origin, ("ack", self._msg_id, result))
+
+    def fail(self, error: BaseException) -> None:
+        self._runtime._send_to(self._origin,
+                               ("fail", self._msg_id, repr(error)))
+
+
+class _NullSink:
+    """Write-discarding stream for follower-side snapshot replay (avoids
+    buffering a full table copy nobody reads)."""
+
+    def write(self, data: bytes) -> int:
+        return len(data)
+
+
+class _WaitResult:
+    """Minimal completion for run_on_dispatcher (kept local to avoid a
+    module cycle with tables.base)."""
+
+    __slots__ = ("_event", "result", "error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def done(self, result: Any) -> None:
+        self.result = result
+        self._event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: float) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("dispatcher execution timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def _is_host_payload(obj: Any) -> bool:
+    import numpy as np
+    if obj is None or isinstance(obj, (int, float, str, bytes, np.ndarray)):
+        return True
+    if isinstance(obj, (tuple, list)):
+        return all(_is_host_payload(x) for x in obj)
+    return False
+
+
+class LockstepTable:
+    """Leader-side ServerTable wrapper: broadcast-then-execute.
+
+    Registered in the leader's server in place of the inner table, so
+    EVERY device-executing path (direct applies, BSP drains,
+    deterministic round drains, admin reads, checkpoint stores) emits a
+    descriptor before it runs — the one invariant multi-controller SPMD
+    needs."""
+
+    def __init__(self, inner: Any, runtime: "MultihostRuntime") -> None:
+        self._inner = inner
+        self._runtime = runtime
+
+    # table_id assignment flows through to the inner table
+    @property
+    def table_id(self) -> int:
+        return self._inner.table_id
+
+    @table_id.setter
+    def table_id(self, value: int) -> None:
+        self._inner.table_id = value
+
+    def process_add(self, request: Any) -> Any:
+        origin, msg_id, request = self._split(request)
+        if (isinstance(request, tuple) and request
+                and isinstance(request[0], str) and request[0] == "transact"):
+            log.fatal("device transactions are in-process only; multihost "
+                      "tables take the staged host path")
+        self._runtime.broadcast_exec("add", self.table_id, origin, msg_id,
+                                     request)
+        return self._inner.process_add(request)
+
+    def process_get(self, request: Any) -> Any:
+        origin, msg_id, request = self._split(request)
+        self._runtime.broadcast_exec("get", self.table_id, origin, msg_id,
+                                     request)
+        return self._inner.process_get(request)
+
+    def store(self, stream) -> None:
+        """Snapshot through the DISPATCHER: the device->host read is a
+        collective, so it must be serialized into the lockstep stream —
+        checkpoint threads cannot broadcast+execute themselves without
+        racing table traffic. The callable below runs on the dispatcher
+        thread: broadcast, then read; followers replay the identical
+        collective into a discarded sink."""
+        def run():
+            self._runtime.broadcast_exec("store", self.table_id, -1, 0,
+                                         None)
+            self._inner.store(stream)
+
+        self._runtime.run_on_dispatcher(run)
+
+    def load(self, stream) -> None:
+        """Restore through the dispatcher: the leader reads the whole
+        per-table checkpoint frame and broadcasts the BYTES, so every
+        process rebuilds identical device state in lockstep order (safe
+        even against live traffic — the dispatcher serializes it)."""
+        payload = stream.read(-1)
+
+        def run():
+            self._runtime.broadcast_exec("load", self.table_id, -1, 0,
+                                         payload)
+            self._inner.load(io.BytesIO(payload))
+
+        self._runtime.run_on_dispatcher(run)
+
+    @staticmethod
+    def _split(request: Any) -> Tuple[int, int, Any]:
+        if isinstance(request, _Forwarded):
+            return request.origin, request.msg_id, request.request
+        return -1, 0, request
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+
+class FollowerServer:
+    """``Zoo.server`` stand-in on follower ranks: forwards local worker
+    requests to the leader and replays the leader's lockstep stream on a
+    single replay thread (the only thread that touches the mesh)."""
+
+    def __init__(self, runtime: "MultihostRuntime") -> None:
+        self._runtime = runtime
+        self._tables: Dict[int, Any] = {}
+        # the leader's server semantics, recomputed from the (identical)
+        # flags — clients consult these capability bits
+        self.gates_gets = bool(config.get_flag("sync"))
+        self.defers_adds = (not self.gates_gets
+                            and bool(config.get_flag("deterministic")))
+
+    @property
+    def plain_async(self) -> bool:
+        # device transactions are in-process-only regardless of the
+        # leader's server type
+        return False
+
+    def start(self) -> None:
+        self._runtime.start_follower(self)
+
+    def stop(self) -> None:
+        pass  # the runtime owns the replay thread; Zoo.stop closes it
+
+    def register_table(self, server_table: Any) -> int:
+        table_id = len(self._tables)
+        # stamp before visibility — replayed descriptors reference the id
+        # the moment the leader-side registration barrier releases
+        server_table.table_id = table_id
+        self._tables[table_id] = server_table
+        return table_id
+
+    def table(self, table_id: int) -> Any:
+        return self._tables[table_id]
+
+    def send(self, msg: Message) -> None:
+        completion = msg.data[-1] if msg.data else None
+        request = msg.data[0] if msg.data else None
+        if completion is not None:
+            self._runtime.register_pending(msg.msg_id, completion)
+        self._runtime.send_to_leader(
+            ("req", int(msg.type), msg.table_id, msg.src, msg.msg_id,
+             request))
+
+    # replay executor ------------------------------------------------------
+    def execute(self, op: str, table_id: int, origin: int, msg_id: int,
+                request: Any) -> None:
+        mine = origin == self._runtime.rank
+        try:
+            table = self._tables[table_id]
+            if op == "add":
+                result = table.process_add(request)
+            elif op == "get":
+                result = table.process_get(request)
+            elif op == "store":
+                # only the collective (device->host read) matters here;
+                # the bytes go to a null sink — the leader owns the file
+                table.store(_NullSink())
+                result = None
+            elif op == "load":
+                table.load(io.BytesIO(request))
+                result = None
+            else:
+                log.fatal("multihost replay: unknown op %r", op)
+        except Exception as exc:
+            log.error("multihost replay %s on table %d failed: %r", op,
+                      table_id, exc)
+            if mine:
+                self._runtime.fail_pending(msg_id, exc)
+            return
+        if mine and op == "get":
+            self._runtime.complete_pending(msg_id, result)
+
+
+class MultihostRuntime:
+    """Control plane: leader accept/forward loops, follower replay loop,
+    broadcast ordering, cross-process barrier."""
+
+    def __init__(self, rank: int, world: int, endpoint: str) -> None:
+        self.rank = rank
+        self.world = world
+        self._endpoint = endpoint
+        self._timeout = float(config.get_flag("multihost_timeout"))
+        self._seq = 0
+        self._stopping = threading.Event()
+        # follower-side: outstanding local requests
+        self._pending: Dict[int, Any] = {}
+        self._pending_lock = threading.Lock()
+        # leader-side: follower sockets by rank
+        self._conns: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._threads: List[threading.Thread] = []
+        self._barrier_arrivals = 0
+        self._barrier_cv = threading.Condition()
+        self._barrier_release = threading.Event()
+        self._server: Optional[Any] = None        # leader: real Server
+        self._follower: Optional[FollowerServer] = None
+        self._leader_sock: Optional[socket.socket] = None
+        self._leader_lock = threading.Lock()
+
+    # -- bring-up ----------------------------------------------------------
+    def connect(self) -> None:
+        host, port = self._endpoint.rsplit(":", 1)
+        if self.rank == 0:
+            listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind((host, int(port)))
+            listener.listen(self.world)
+            listener.settimeout(self._timeout)
+            while len(self._conns) < self.world - 1:
+                conn, _addr = listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # bound the hello read too: an accepted connection that
+                # never speaks (scanner, half-dead follower) must not
+                # wedge bring-up past the configured timeout
+                conn.settimeout(self._timeout)
+                try:
+                    hello = _recv_obj(conn)
+                except (OSError, pickle.UnpicklingError):
+                    hello = None
+                if not (isinstance(hello, tuple) and len(hello) == 2
+                        and hello[0] == "hello"):
+                    log.error("multihost: dropping connection with bad "
+                              "handshake %r", hello)
+                    conn.close()
+                    continue
+                peer = int(hello[1])
+                if not 1 <= peer < self.world or peer in self._conns:
+                    log.fatal("multihost: follower handshake claims rank "
+                              "%d (world %d, already connected: %s)",
+                              peer, self.world, sorted(self._conns))
+                conn.settimeout(None)
+                self._conns[peer] = conn
+                self._send_locks[peer] = threading.Lock()
+            listener.close()
+            for peer, conn in self._conns.items():
+                t = threading.Thread(target=self._leader_recv_loop,
+                                     args=(peer, conn),
+                                     name=f"mv-multihost-recv-{peer}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        else:
+            import time
+            deadline = time.monotonic() + self._timeout
+            sock = None
+            while True:
+                try:
+                    sock = socket.create_connection(
+                        (host, int(port)),
+                        timeout=max(1.0, deadline - time.monotonic()))
+                    break
+                except OSError:
+                    # the leader may not have bound yet — retry until the
+                    # handshake window closes
+                    if time.monotonic() >= deadline:
+                        log.fatal("multihost: cannot reach leader at %s "
+                                  "within %.0fs", self._endpoint,
+                                  self._timeout)
+                    time.sleep(0.1)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+            self._leader_sock = sock
+            _send_obj(sock, self._leader_lock, ("hello", self.rank))
+
+    def attach_leader(self, server: Any) -> None:
+        self._server = server
+
+    def wrap_table(self, server_table: Any) -> LockstepTable:
+        return LockstepTable(server_table, self)
+
+    def start_follower(self, follower: FollowerServer) -> None:
+        self._follower = follower
+        t = threading.Thread(target=self._replay_loop,
+                             name="mv-multihost-replay", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # -- leader side -------------------------------------------------------
+    def run_on_dispatcher(self, fn: Any) -> Any:
+        """Execute ``fn`` on the leader's dispatcher thread, serialized
+        with table traffic, and return its result. If already on the
+        dispatcher thread, run inline (re-entrant store/load)."""
+        if threading.current_thread() is getattr(self._server, "_thread",
+                                                 None):
+            return fn()
+        waiter = _WaitResult()
+        self._server.send(Message(src=-1, dst=-1,
+                                  type=MsgType.Server_Execute,
+                                  data=[fn, waiter]))
+        return waiter.wait(self._timeout)
+
+    def broadcast_exec(self, op: str, table_id: int, origin: int,
+                       msg_id: int, request: Any) -> None:
+        """Emit one lockstep descriptor to every follower. Must run on
+        the leader's dispatcher thread — that single thread's execution
+        order IS the collective program order every process must share;
+        a broadcast from any other thread could interleave differently
+        with the leader's own executions."""
+        expected = getattr(self._server, "_thread", None)
+        if expected is not None and threading.current_thread() is not expected:
+            log.fatal("multihost: broadcast_exec off the dispatcher thread "
+                      "(%s) — route through run_on_dispatcher",
+                      threading.current_thread().name)
+        # pickle BEFORE consuming a sequence number: a non-serializable
+        # request must fail only itself, not desync every follower's
+        # expected seq (the fatal propagates to the requester's completion
+        # via Server._main; the lockstep stream stays consistent)
+        desc = ("exec", self._seq + 1, op, table_id, origin, msg_id, request)
+        try:
+            payload = pickle.dumps(desc, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as exc:
+            log.fatal("multihost: request is not host-serializable (%r) — "
+                      "device-array payloads cannot cross processes; use "
+                      "the host add/get paths", exc)
+        self._seq += 1
+        framed = _LEN.pack(len(payload)) + payload
+        for peer in sorted(self._conns):
+            sock = self._conns[peer]
+            try:
+                with self._send_locks[peer]:
+                    sock.sendall(framed)
+            except OSError as exc:
+                # a peer that missed a descriptor can never rejoin the
+                # stream — drop it loudly; its absence surfaces at the
+                # next collective (Gloo) rather than as silent corruption
+                log.error("multihost: lost follower %d mid-broadcast (%r);"
+                          " dropping it from the control plane", peer, exc)
+                self._conns.pop(peer, None)
+
+    def _leader_recv_loop(self, peer: int, conn: socket.socket) -> None:
+        while True:
+            obj = _recv_obj(conn)
+            if obj is None:
+                if not self._stopping.is_set():
+                    log.error("multihost: lost follower %d", peer)
+                return
+            kind = obj[0]
+            if kind == "req":
+                _, msg_type, table_id, src, msg_id, request = obj
+                msg_type = MsgType(msg_type)
+                data: List[Any] = []
+                if msg_type.is_server_bound and msg_type in (
+                        MsgType.Request_Add, MsgType.Request_Get):
+                    completion = _ForwardCompletion(
+                        self, peer, msg_id,
+                        is_add=msg_type == MsgType.Request_Add)
+                    data = [_Forwarded(peer, msg_id, request), completion]
+                self._server.send(Message(
+                    src=src, dst=-1, type=msg_type, table_id=table_id,
+                    msg_id=msg_id, data=data))
+            elif kind == "barrier_enter":
+                with self._barrier_cv:
+                    self._barrier_arrivals += 1
+                    self._barrier_cv.notify_all()
+            elif kind == "bye":
+                return
+            else:
+                log.error("multihost: unknown message %r from %d", kind,
+                          peer)
+
+    def _send_to(self, peer: int, obj: Any) -> None:
+        if peer < 0:
+            return
+        sock = self._conns.get(peer)
+        if sock is None:
+            return
+        try:
+            _send_obj(sock, self._send_locks[peer], obj)
+        except OSError as exc:
+            log.error("multihost: send to %d failed: %r", peer, exc)
+
+    # -- follower side -----------------------------------------------------
+    def send_to_leader(self, obj: Any) -> None:
+        _send_obj(self._leader_sock, self._leader_lock, obj)
+
+    def register_pending(self, msg_id: int, completion: Any) -> None:
+        with self._pending_lock:
+            self._pending[msg_id] = completion
+
+    def complete_pending(self, msg_id: int, result: Any) -> None:
+        with self._pending_lock:
+            completion = self._pending.pop(msg_id, None)
+        if completion is not None:
+            completion.done(result)
+
+    def fail_pending(self, msg_id: int, exc: BaseException) -> None:
+        with self._pending_lock:
+            completion = self._pending.pop(msg_id, None)
+        if completion is not None:
+            completion.fail(exc if isinstance(exc, Exception)
+                            else RuntimeError(repr(exc)))
+
+    def _replay_loop(self) -> None:
+        expect_seq = 0
+        while True:
+            obj = _recv_obj(self._leader_sock)
+            if obj is None:
+                if not self._stopping.is_set():
+                    log.error("multihost: lost leader connection")
+                return
+            kind = obj[0]
+            if kind == "exec":
+                _, seq, op, table_id, origin, msg_id, request = obj
+                expect_seq += 1
+                if seq != expect_seq:
+                    log.fatal("multihost replay out of order: seq %d, "
+                              "expected %d — collective stream corrupt",
+                              seq, expect_seq)
+                self._follower.execute(op, table_id, origin, msg_id,
+                                       request)
+            elif kind == "ack":
+                self.complete_pending(obj[1], obj[2])
+            elif kind == "fail":
+                self.fail_pending(obj[1], RuntimeError(obj[2]))
+            elif kind == "barrier_release":
+                self._barrier_release.set()
+            elif kind == "stop":
+                self._stopping.set()
+                return
+            else:
+                log.error("multihost: unknown descriptor %r", kind)
+
+    # -- barrier -----------------------------------------------------------
+    def barrier(self) -> None:
+        """Cross-process rendezvous over the control plane (the analog of
+        the reference Controller's Barrier message round,
+        ``src/controller.cpp:82-107``)."""
+        if self.rank == 0:
+            with self._barrier_cv:
+                if not self._barrier_cv.wait_for(
+                        lambda: self._barrier_arrivals >= self.world - 1,
+                        timeout=self._timeout):
+                    log.fatal("multihost barrier timed out "
+                              "(%d/%d followers arrived)",
+                              self._barrier_arrivals, self.world - 1)
+                self._barrier_arrivals -= self.world - 1
+            for peer in sorted(self._conns):
+                self._send_to(peer, ("barrier_release",))
+        else:
+            self._barrier_release.clear()
+            self.send_to_leader(("barrier_enter", self.rank))
+            if not self._barrier_release.wait(self._timeout):
+                log.fatal("multihost barrier timed out waiting for release")
+
+    # -- teardown ----------------------------------------------------------
+    def shutdown(self) -> None:
+        self._stopping.set()
+        if self.rank == 0:
+            for peer in sorted(self._conns):
+                self._send_to(peer, ("stop",))
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        else:
+            try:
+                self.send_to_leader(("bye",))
+            except OSError:
+                pass
+            # let the replay thread consume the leader's "stop" so no
+            # lockstep descriptor is dropped mid-collective
+            for t in self._threads:
+                t.join(timeout=self._timeout)
+            if self._leader_sock is not None:
+                try:
+                    self._leader_sock.close()
+                except OSError:
+                    pass
+                self._leader_sock = None
+
+
+def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
+                         devices_per_proc: int = 4,
+                         timeout: float = 300.0) -> List[str]:
+    """Launch ``world`` OS processes running ``child_script`` (rank, world,
+    coordinator port, control port, scenario argv) with per-process virtual
+    CPU devices — the shared harness behind tests/test_multihost.py and
+    __graft_entry__.dryrun_multichip's multiprocess leg. Returns each
+    rank's combined output; raises RuntimeError on any failure or missing
+    OK marker."""
+    import os
+    import subprocess
+    import sys
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    coord, ctl = free_port(), free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{devices_per_proc}")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("_MV_DRYRUN_CHILD", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, child_script, str(rank), str(world),
+             str(coord), str(ctl), scenario],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=repo)
+        for rank in range(world)
+    ]
+    outs: List[str] = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 or f"MULTIHOST_CHILD_OK rank={rank}" not in out:
+            raise RuntimeError(f"lockstep world rank {rank} failed "
+                               f"(rc={p.returncode}):\n{out}")
+    return outs

@@ -76,6 +76,11 @@ class Server:
 
     def register_table(self, server_table) -> int:
         table_id = len(self._tables)
+        # stamp the id BEFORE the table becomes dispatchable: a forwarded
+        # multihost request can hit process_add the instant the dict entry
+        # exists, and the lockstep wrapper broadcasts server_table.table_id
+        # (WorkerTable._register re-stamps the same value later)
+        server_table.table_id = table_id
         self._tables[table_id] = server_table
         return table_id
 
@@ -105,6 +110,12 @@ class Server:
             self._process_add(msg)
         elif msg.type == MsgType.Request_Get:
             self._process_get(msg)
+        elif msg.type == MsgType.Server_Execute:
+            # administrative callable, serialized with table traffic (used
+            # by the multihost lockstep checkpoint path): never clocked,
+            # identical on every server flavor
+            fn, completion = msg.data
+            completion.done(fn())
         elif msg.type == MsgType.Server_Finish_Train:
             self._process_finish_train(msg)
         else:

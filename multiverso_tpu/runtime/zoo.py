@@ -6,7 +6,7 @@ barrier (``include/multiverso/zoo.h:19-85``, ``src/zoo.cpp``). Rank-0 ran a
 Controller actor assigning worker/server ids and broadcasting membership
 (``src/controller.cpp:38-80``).
 
-TPU-native re-design: ONE process owns the mesh and the dispatcher; its
+TPU-native re-design: ONE logical dispatcher owns request ordering; its
 membership is static and known at init, so the register protocol
 degenerates to arithmetic — the Controller actor is subsumed by
 :meth:`Zoo._assign_ids`. The *logical worker* concept is kept first-class:
@@ -14,10 +14,18 @@ the reference scaled workers by adding MPI ranks; here a process hosts
 ``local_workers`` worker contexts (threads) plus ``remote_workers`` off-mesh
 clients that register over the wire (:mod:`multiverso_tpu.runtime.remote`,
 the reference's RegisterNode path). Server "ranks" are device shards of the
-table mesh. Multi-process JAX runtimes are rejected at init: a host-thread
-dispatcher issuing jitted ops on globally-sharded arrays is not
-collective-safe across processes, so scaling across hosts is by off-mesh
-workers, matching the reference's worker/server process split.
+table mesh.
+
+Multi-process JAX runtimes (``jax.distributed`` — the mesh spans several
+hosts' devices) run the LOCKSTEP protocol
+(:mod:`multiverso_tpu.runtime.multihost`): process 0 hosts the real
+dispatcher and broadcasts every device-executing request descriptor; the
+other processes replay the identical stream so all controllers issue the
+same collective program — tables then shard across every host's HBM, the
+reference's add-ranks scaling story on the TPU substrate. Requires the
+same flags (sync/deterministic/local_workers/multihost_endpoint) on
+every process, uniform roles, and tables created collectively (same
+order on every process) before training traffic.
 """
 
 from __future__ import annotations
@@ -54,6 +62,7 @@ class Zoo:
         self.mesh: Optional[jax.sharding.Mesh] = None
         self.server: Optional[Server] = None
         self.remote_server: Optional[Any] = None  # runtime.remote.RemoteServer
+        self.multihost: Optional[Any] = None  # runtime.multihost.MultihostRuntime
         self._local_workers = 1
         self._remote_workers = 0
         self._process_index = 0
@@ -85,16 +94,21 @@ class Zoo:
         self._process_index = jax.process_index()
         self._process_count = jax.process_count()
         if self._process_count > 1:
-            # The PS contract is ONE mesh-owning process: the dispatcher
-            # thread issues jitted ops on sharded arrays, which is not
-            # collective-safe across JAX processes. Scale across hosts with
-            # off-mesh workers instead: mv.serve() here, mv.remote_connect()
-            # there (the reference's multi-rank shape), or raw-net
-            # allreduce for ma-style deployments.
-            log.fatal(
-                "multi-process JAX runtimes are unsupported for the PS "
-                "path (process_count=%d); attach off-mesh workers via "
-                "mv.serve()/mv.remote_connect()", self._process_count)
+            # Multi-process mesh: run the lockstep protocol so every
+            # controller issues the same collective program (see module
+            # docstring and runtime/multihost.py).
+            endpoint = config.get_flag("multihost_endpoint")
+            if not endpoint:
+                log.fatal(
+                    "multi-process JAX runtime (process_count=%d) needs "
+                    "-multihost_endpoint=host:port — the lockstep control "
+                    "plane process 0 binds; alternatively scale with "
+                    "off-mesh workers via mv.serve()/mv.remote_connect()",
+                    self._process_count)
+            from multiverso_tpu.runtime.multihost import MultihostRuntime
+            self.multihost = MultihostRuntime(
+                self._process_index, self._process_count, endpoint)
+            self.multihost.connect()
         self.node.rank = self._process_index
         self.node.role = Role.from_string(config.get_flag("ps_role"))
         self._local_workers = max(1, config.get_flag("local_workers"))
@@ -109,7 +123,13 @@ class Zoo:
         if not config.get_flag("ma"):
             # model-averaging mode skips the PS path entirely (reference:
             # `-ma=true` skips StartPS)
-            self.server = make_server(self.num_workers)
+            if self.multihost is not None and self.rank != 0:
+                from multiverso_tpu.runtime.multihost import FollowerServer
+                self.server = FollowerServer(self.multihost)
+            else:
+                self.server = make_server(self.num_workers)
+                if self.multihost is not None:
+                    self.multihost.attach_leader(self.server)
             self.server.start()
         self._started = True
         log.debug("Zoo started: rank=%d/%d workers=%d servers=%d mesh=%s",
@@ -128,6 +148,9 @@ class Zoo:
         if self.server is not None:
             self.server.stop()
             self.server = None
+        if self.multihost is not None:
+            self.multihost.shutdown()
+            self.multihost = None
         self._worker_tables.clear()
         self._started = False
         if finalize_net:
@@ -226,16 +249,32 @@ class Zoo:
             self._barrier.wait()
 
     def process_barrier(self) -> None:
-        """Lifecycle hook; a no-op under the single-mesh-process contract
-        (kept so lifecycle code reads the same as the reference's
-        barrier-after-create shape)."""
+        """Cross-process rendezvous: real over the multihost control plane,
+        a no-op under the single-mesh-process contract (kept so lifecycle
+        code reads the same as the reference's barrier-after-create
+        shape)."""
+        if self.multihost is not None:
+            self.multihost.barrier()
 
     # -- tables ------------------------------------------------------------
     def register_table(self, worker_table: Any, server_table: Any) -> int:
         if self.server is None:
             log.fatal("register_table: PS disabled (ma mode) or Zoo not started")
+        if self.multihost is not None and self.rank == 0:
+            # leader: every device-executing path must broadcast a lockstep
+            # descriptor before it runs — register the wrapper, and point
+            # the worker proxy at it so checkpoint/store calls stay safe
+            server_table = self.multihost.wrap_table(server_table)
+            if hasattr(worker_table, "_server_table"):
+                worker_table._server_table = server_table
         table_id = self.server.register_table(server_table)
         self._worker_tables.append(worker_table)
+        if self.multihost is not None:
+            # table creation is collective (same order on every process);
+            # rendezvous here so no process can reference table_id before
+            # every process has registered it — the create-before-traffic
+            # contract the reference enforced with its post-create barrier
+            self.multihost.barrier()
         return table_id
 
     # -- aggregate (model averaging) ----------------------------------------

@@ -305,7 +305,7 @@ class ArrayServer(ServerTable):
         if device_out:
             return self._device_value()  # stays in HBM, donation-safe
         out = self.updater.access(self.data)
-        return np.asarray(jax.device_get(out))[: self.size]
+        return self._host_read(out)[: self.size]
 
     def remote_spec(self):
         return {"kind": "array", "size": self.size, "dtype": self.dtype.str}
@@ -313,7 +313,7 @@ class ArrayServer(ServerTable):
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
         from multiverso_tpu.checkpoint import write_array
-        write_array(stream, np.asarray(jax.device_get(self.data))[: self.size])
+        write_array(stream, self._host_read(self.data)[: self.size])
 
     def load(self, stream) -> None:
         from multiverso_tpu.checkpoint import read_array
@@ -338,6 +338,10 @@ class ArrayWorker(WorkerTable):
         self._server_table = server or ArrayServer(
             size, dtype, updater_type, init_value=init_value)
         self._register(self._server_table)
+        if Zoo.instance().multihost is not None:
+            # device IO exchanges jax.Arrays with the dispatcher; lockstep
+            # descriptors must be host-serializable — host paths only
+            self.supports_device_io = False
 
     # -- API (mirrors reference ArrayWorker + python binding handler) -------
     def get(self, option: Optional[GetOption] = None) -> np.ndarray:
@@ -371,12 +375,14 @@ class ArrayWorker(WorkerTable):
         """Dispatcher-ordered Get whose reply STAYS in HBM: a (size,)
         jax.Array reflecting every add queued before it. Unlike
         :meth:`get_device` this is safe against concurrent adds."""
+        self._require_device_io()
         return super().get_async((option, True))
 
     def add_device_async(self, delta: "jax.Array",
                          option: Optional[AddOption] = None) -> int:
         """Async add of a DEVICE-resident (size,) delta — no host copy;
         the dispatcher applies it via the same jitted updater."""
+        self._require_device_io()
         option = self._default_option(option)
         return super().add_async((delta, option))
 
@@ -386,6 +392,7 @@ class ArrayWorker(WorkerTable):
         post-add global value in HBM. Deferred-apply servers (BSP /
         deterministic) reply None — callers fall back to an explicit
         get_device_async."""
+        self._require_device_io()
         option = self._default_option(option)
         return super().add_async((delta, option, True))
 
@@ -404,6 +411,7 @@ class ArrayWorker(WorkerTable):
         ``(merged, baseline)`` where ``baseline`` is a distinct buffer set
         the caller may keep while donating ``merged``. ``last_leaves`` is
         donated — the caller must own those buffers exclusively."""
+        self._require_device_io()
         option = self._default_option(option)
         if last_leaves is not None:
             return super().add_async(("leaves_sync", list(delta_leaves),
@@ -416,6 +424,7 @@ class ArrayWorker(WorkerTable):
         materializes nothing. For round-gated/deferred servers, where a
         fused merged reply would be discarded anyway — follow with a
         (gated) ``get_leaves_async``. ``last_leaves`` is donated."""
+        self._require_device_io()
         option = self._default_option(option)
         return super().add_async(("leaves_push", list(new_leaves),
                                   list(last_leaves), option))
@@ -424,4 +433,5 @@ class ArrayWorker(WorkerTable):
                          option: Optional[GetOption] = None) -> int:
         """Device get shaped like ``template_leaves`` (values unused, only
         shapes/dtypes), single-device committed."""
+        self._require_device_io()
         return super().get_async(("leaves", list(template_leaves), option))

@@ -127,6 +127,14 @@ class WorkerTable:
         into user buffers). Default: identity."""
         return raw
 
+    def _require_device_io(self) -> None:
+        """Guard for device-array-exchanging entry points: in-process
+        proxies only — multihost lockstep descriptors and remote wire
+        requests must be host-serializable."""
+        if not getattr(self, "supports_device_io", False):
+            log.fatal("device IO is in-process only (multihost/remote "
+                      "proxies take the host paths)")
+
     # -- sync wrappers (Get/Add = Wait(Async)) ------------------------------
     # NOTE: these call _submit directly (not self.get_async) so subclasses can
     # override the async methods with their own signatures safely.
@@ -149,6 +157,7 @@ class ServerTable:
 
     def __init__(self) -> None:
         self.table_id: int = -1
+        self._replicate = None  # lazy replicate-jit for multihost host reads
         # (scalars tuple, worker) -> device constants, LRU-bounded. A
         # repeated AddOption envelope (fixed-lr hot paths) hits the cache
         # and skips two host->device transfers per add; a churning
@@ -189,6 +198,26 @@ class ServerTable:
         """Metadata a remote client needs to build a matching worker proxy
         (kind + shape + dtype); None = not servable over the wire."""
         return None
+
+    def _host_read(self, arr) -> Any:
+        """Device->host read of table state. Under a multi-process mesh the
+        array is globally sharded and not fully addressable from one
+        controller, so route through a replicating jit first (an XLA
+        allgather — collective, which is safe here because every host-read
+        site runs on the lockstep dispatcher/replay thread). Single-process
+        meshes skip straight to ``device_get``."""
+        import jax
+        import numpy as np
+        from multiverso_tpu.runtime.zoo import Zoo
+        if Zoo.instance().multihost is not None:
+            if self._replicate is None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._replicate = jax.jit(
+                    lambda x: x,
+                    out_shardings=NamedSharding(self.mesh,
+                                                PartitionSpec()))
+            arr = self._replicate(arr)
+        return np.asarray(jax.device_get(arr))
 
     def process_add(self, request: Any) -> None:
         raise NotImplementedError

@@ -72,12 +72,12 @@ class FTRLServer(ServerTable):
 
     def process_get(self, request: Any) -> np.ndarray:
         w = self._weights(self.z, self.n)
-        return np.asarray(jax.device_get(w))[: self.size]
+        return self._host_read(w)[: self.size]
 
     def store(self, stream) -> None:
         from multiverso_tpu.checkpoint import write_array
-        write_array(stream, np.asarray(jax.device_get(self.z))[: self.size])
-        write_array(stream, np.asarray(jax.device_get(self.n))[: self.size])
+        write_array(stream, self._host_read(self.z)[: self.size])
+        write_array(stream, self._host_read(self.n)[: self.size])
 
     def load(self, stream) -> None:
         from multiverso_tpu.checkpoint import read_array

@@ -159,17 +159,18 @@ class DeviceKVServer(ServerTable):
         bk = jnp.asarray(self._bucket(ukeys, -1, np.int32))
         bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
         self.keys, self.values, ovf = self._add(self.keys, self.values, bk, bv)
-        if int(np.asarray(ovf).sum()):
+        n_ovf = int(self._host_read(ovf).sum())
+        if n_ovf:
             log.fatal("DeviceKV capacity exhausted (%d keys overflowed; "
-                      "capacity=%d)", int(np.asarray(ovf).sum()), self.capacity)
+                      "capacity=%d)", n_ovf, self.capacity)
 
     def process_get(self, request):
         import jax
         import jax.numpy as jnp
         keys, _option = request
         if keys is None:
-            k = np.asarray(jax.device_get(self.keys))[:, :-1].reshape(-1)
-            v = np.asarray(jax.device_get(self.values))[:, :-1].reshape(-1)
+            k = self._host_read(self.keys)[:, :-1].reshape(-1)
+            v = self._host_read(self.values)[:, :-1].reshape(-1)
             live = k >= 0
             return {int(kk): self.value_dtype.type(vv)
                     for kk, vv in zip(k[live], v[live])}

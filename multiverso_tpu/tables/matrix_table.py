@@ -334,7 +334,7 @@ class MatrixServer(ServerTable):
                 return self._sparse_get(option)
             # admin whole-table reads take the dense path
             out = self.updater.access(self.data)
-            return np.asarray(jax.device_get(out))[: self.num_row, : self.num_col]
+            return self._host_read(out)[: self.num_row, : self.num_col]
         row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
         ids_p, _, n = self._bucket_ids(row_ids, None, ensure_pad=device_out)
         gathered = self._gather(self.data, ids_p)
@@ -345,7 +345,7 @@ class MatrixServer(ServerTable):
             # rows stay in HBM: (bucket, padded_cols), slots >= n are
             # sentinel copies — the caller's compact training space
             return gathered
-        return np.asarray(jax.device_get(gathered))[:n, : self.num_col]
+        return self._host_read(gathered)[:n, : self.num_col]
 
     def _sparse_get(self, option: GetOption):
         """Return only the rows stale for this worker: (ids, rows)."""
@@ -356,11 +356,11 @@ class MatrixServer(ServerTable):
         if len(stale) == 0:
             return stale, np.zeros((0, self.num_col), dtype=self.dtype)
         if len(stale) == self.num_row:
-            return stale, np.asarray(
-                jax.device_get(self.data))[: self.num_row, : self.num_col]
+            return stale, self._host_read(
+                self.data)[: self.num_row, : self.num_col]
         ids_p, _, n = self._bucket_ids(stale, None)
-        rows = np.asarray(jax.device_get(
-            self._gather(self.data, ids_p)))[:n, : self.num_col]
+        rows = self._host_read(
+            self._gather(self.data, ids_p))[:n, : self.num_col]
         return stale, rows
 
     def remote_spec(self):
@@ -373,8 +373,9 @@ class MatrixServer(ServerTable):
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
         from multiverso_tpu.checkpoint import write_array
-        write_array(stream, np.asarray(
-            jax.device_get(self.data))[: self.num_row, : self.num_col])
+        write_array(stream,
+                    self._host_read(self.data)[: self.num_row,
+                                               : self.num_col])
 
     def load(self, stream) -> None:
         from multiverso_tpu.checkpoint import read_array
@@ -410,6 +411,10 @@ class MatrixWorker(WorkerTable):
             init_range=init_range, seed=seed, is_sparse=is_sparse,
             is_pipelined=is_pipelined)
         self._register(self._server_table)
+        if Zoo.instance().multihost is not None:
+            # device IO exchanges jax.Arrays with the dispatcher; lockstep
+            # descriptors must be host-serializable — host paths only
+            self.supports_device_io = False
         self._init_client_state(self._server_table.is_pipelined
                                 if self.is_sparse else False,
                                 self._server_table.num_workers)
@@ -506,6 +511,7 @@ class MatrixWorker(WorkerTable):
         a compact training space."""
         if self.is_sparse:
             log.fatal("device IO is not available on is_sparse tables")
+        self._require_device_io()
         option, _ = self._prep_get_option(option, row_ids)
         return super().get_async((self._norm_ids(row_ids), option, True))
 
@@ -522,6 +528,7 @@ class MatrixWorker(WorkerTable):
         caller pads) aim at ``num_row`` (the sentinel) with zero deltas."""
         if self.is_sparse:
             log.fatal("device IO is not available on is_sparse tables")
+        self._require_device_io()
         option = self._default_add_option(option)
         return super().add_async(
             (np.asarray(row_ids, np.int32).reshape(-1), values, option))
@@ -543,6 +550,7 @@ class MatrixWorker(WorkerTable):
         there."""
         if self.is_sparse:
             log.fatal("device IO is not available on is_sparse tables")
+        self._require_device_io()
         server = Zoo.instance().server
         if not getattr(server, "plain_async", False):
             log.fatal("transact_device_async requires the plain async "

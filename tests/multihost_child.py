@@ -1,0 +1,138 @@
+"""Child process for tests/test_multihost.py: one JAX process of a
+2-process lockstep PS world (reference analog: one MPI rank of the
+multi-rank deployment, ``src/zoo.cpp:73-145``).
+
+Usage: python multihost_child.py <rank> <world> <coord_port> <ctl_port>
+       <scenario>
+
+The parent sets JAX_PLATFORMS=cpu and
+XLA_FLAGS=--xla_force_host_platform_device_count=<n> so the two
+processes form a 2n-device global mesh; MatrixTable/ArrayTable rows then
+shard across BOTH processes' devices — the capability this validates is
+exactly "tables bigger than one host".
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    world = int(sys.argv[2])
+    coord_port = sys.argv[3]
+    ctl_port = sys.argv[4]
+    scenario = sys.argv[5]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{coord_port}", world, rank)
+
+    import numpy as np
+    import multiverso_tpu as mv
+
+    flags = dict(local_workers=1, remote_workers=0,
+                 multihost_endpoint=f"127.0.0.1:{ctl_port}",
+                 sync=scenario == "bsp")
+    mv.init(**flags)
+    assert jax.device_count() > jax.local_device_count(), \
+        "mesh does not span processes"
+
+    if scenario == "async":
+        run_async(mv, np, rank, world)
+    elif scenario == "bsp":
+        run_bsp(mv, np, rank, world)
+    elif scenario == "checkpoint":
+        run_checkpoint(mv, np, rank, world)
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+    mv.shutdown()
+    print(f"MULTIHOST_CHILD_OK rank={rank} scenario={scenario}", flush=True)
+    return 0
+
+
+def run_async(mv, np, rank: int, world: int) -> None:
+    """Plain async: every rank's sync add is visible after a barrier."""
+    rows, cols = 64, 24
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    arr = mv.create_table("array", size=100)
+    with mv.worker(0):
+        my_rows = np.arange(rank, rows, world, dtype=np.int32)
+        mat.add(np.full((len(my_rows), cols), rank + 1.0, np.float32),
+                row_ids=my_rows)  # sync add: applied when it returns
+        arr.add(np.full(100, float(rank + 1), np.float32))
+    mv.process_barrier()
+    with mv.worker(0):
+        got = mat.get()
+        expect = np.zeros((rows, cols), np.float32)
+        for r in range(world):
+            expect[np.arange(r, rows, world)] = r + 1.0
+        np.testing.assert_allclose(got, expect)
+        # row-subset get crossing both processes' shards
+        sel = np.array([0, 1, rows - 1], np.int32)
+        np.testing.assert_allclose(mat.get(sel), expect[sel])
+        np.testing.assert_allclose(
+            arr.get(), np.full(100, sum(range(1, world + 1)), np.float32))
+
+
+def run_checkpoint(mv, np, rank: int, world: int) -> None:
+    """Live snapshot + live restore through the lockstep dispatcher: the
+    leader's CheckpointDriver broadcasts the collective store read and
+    the restore bytes; followers participate via replay only (a follower
+    driving the checkpoint is rejected — tested too)."""
+    import tempfile
+
+    from multiverso_tpu.checkpoint import CheckpointDriver
+
+    rows, cols = 48, 16
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    with mv.worker(0):
+        mat.add(np.full((rows, cols), float(rank + 1), np.float32))
+    mv.process_barrier()
+    base = float(sum(range(1, world + 1)))
+
+    driver = None
+    if rank == 0:
+        driver = CheckpointDriver([mat], tempfile.mkdtemp(prefix="mvckpt_"))
+        driver.snapshot()
+    mv.process_barrier()
+
+    with mv.worker(0):
+        mat.add(np.full((rows, cols), 10.0, np.float32))  # every rank adds
+    mv.process_barrier()
+    with mv.worker(0):
+        np.testing.assert_allclose(
+            mat.get(),
+            np.full((rows, cols), base + 10.0 * world, np.float32))
+    mv.process_barrier()
+
+    if rank == 0:
+        assert driver.restore(), "no snapshot found"
+    mv.process_barrier()
+    with mv.worker(0):
+        np.testing.assert_allclose(
+            mat.get(), np.full((rows, cols), base, np.float32),
+            err_msg="restore did not rebuild pre-snapshot state")
+    mv.process_barrier()
+
+
+def run_bsp(mv, np, rank: int, world: int) -> None:
+    """BSP contract across processes: worker w's round-i Get observes
+    exactly i rounds of EVERY worker's Adds (the reference SyncServer
+    contract, test_sync.cpp shape), with one worker per process."""
+    rows, cols = 32, 8
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    rounds = 4
+    with mv.worker(0):
+        for i in range(1, rounds + 1):
+            mat.add(np.full((rows, cols), float(rank + 1), np.float32))
+            got = mat.get()
+            np.testing.assert_allclose(
+                got, np.full((rows, cols),
+                             i * sum(range(1, world + 1)), np.float32),
+                err_msg=f"round {i} BSP contract violated")
+        mat.finish_train()
+    mv.process_barrier()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

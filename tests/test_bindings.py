@@ -44,6 +44,31 @@ def test_lua_binding_symbols_resolve():
         assert f"lib.{sym}(" in body, f"{sym} declared but never called"
 
 
+def test_lua_ffi_replay_end_to_end():
+    """No LuaJIT ships in this image, so the Lua binding's exact FFI call
+    sequence is executed by native/test_lua_ffi.c instead: dlopen+dlsym
+    resolution (ffi.load), per-call heap buffers (ffi.new), argv/row-id
+    marshalling, async-by-default adds — plus the reference xor.lua
+    workload shape, an XOR net trained with parameters living in an
+    ArrayTable. Real data crosses the FFI boundary in both directions and
+    learning is asserted (the reference shipped binding/lua/test.lua and
+    xor.lua as exactly this kind of proof)."""
+    import os
+
+    _build_native()
+    subprocess.run(["make", "-C", str(NATIVE), "test_lua_ffi", "CC=gcc"],
+                   check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    result = subprocess.run([str(NATIVE / "test_lua_ffi")], env=env,
+                            cwd=str(NATIVE), capture_output=True, text=True,
+                            timeout=240)
+    assert result.returncode == 0, (result.stdout + result.stderr)[-2000:]
+    assert "lua ffi replay passed" in result.stdout
+
+
 def test_csharp_binding_symbols_resolve():
     lib = _build_native()
     cs = (REPO / "bindings" / "csharp" / "MultiversoTPU.cs").read_text()

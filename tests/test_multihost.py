@@ -1,0 +1,36 @@
+"""Multi-process lockstep PS tests: two REAL OS processes under
+``jax.distributed``, each with 4 virtual CPU devices, forming one
+8-device global mesh — tables shard across BOTH processes' devices
+(reference analog: the multi-rank MPI deployment, src/zoo.cpp:73-145;
+here XLA collectives replace MPI and the lockstep control plane replaces
+message ordering — see multiverso_tpu/runtime/multihost.py)."""
+
+from pathlib import Path
+
+from multiverso_tpu.runtime.multihost import spawn_lockstep_world
+
+_CHILD = str(Path(__file__).resolve().parent / "multihost_child.py")
+
+
+def test_multihost_async_add_get():
+    """Async mode: each rank's sync Adds land on the globally-sharded
+    table; whole-table and cross-shard row-subset Gets agree on every
+    rank (follower Gets materialize locally via the replicated-out
+    collective, not TCP payloads)."""
+    spawn_lockstep_world(_CHILD, "async")
+
+
+def test_multihost_bsp_contract():
+    """BSP across processes: with one worker per process, worker w's
+    round-i Get observes exactly i rounds of every worker's Adds — the
+    reference SyncServer contract (Test/unittests/test_sync.cpp shape)
+    surviving the process hop."""
+    spawn_lockstep_world(_CHILD, "bsp")
+
+
+def test_multihost_checkpoint_snapshot_restore():
+    """Live snapshot + live restore through the lockstep dispatcher:
+    snapshot on the leader broadcasts the collective device->host read;
+    restore broadcasts the checkpoint bytes so every process rebuilds
+    identical device state."""
+    spawn_lockstep_world(_CHILD, "checkpoint")
