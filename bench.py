@@ -114,11 +114,19 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
     return words / per_pass, loss
 
 
-def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
+def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4,
+                      group=8):
     """End-to-end parameter-server words/sec: the full product path —
     candidate-row pulls through the dispatcher, compact-space scan training,
     delta pushes through the updater (the reference's only benchmarked
     configuration: WordEmbedding skip-gram on PS tables).
+
+    ``group`` coalesces that many 8192-token blocks per submission — the
+    production ``PSTrainer.train(group=...)`` recipe: per-submission fixed
+    costs (candidate shaping, the packed upload, the fused dispatch at
+    ~2.6 ms each through the tunnel) amortize group-fold while the kernel
+    still chunks internally at batch_pairs granularity, so the per-row
+    update schedule matches ungrouped feeding.
 
     Timing is wall-clock over the PIPELINED submit/finish loop (the
     reference's benchmarked configuration ran its block pipeline,
@@ -129,8 +137,8 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
     pipeline (per-block stats fetches would insert a full tunnel round
     trip between submissions and measure the tunnel, not the product).
     Compile time is excluded by warming every block (all trace buckets)
-    before timing; the figure is the best-of-3 average over 16
-    steady-state blocks.
+    before timing; the figure is the best-of-reps average over the
+    steady-state submissions.
     """
     import multiverso_tpu as mv
     from multiverso_tpu.models.vocab import Dictionary
@@ -147,8 +155,9 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
     p = counts.astype(np.float64) / counts.sum()
     cdf = np.cumsum(p)
     rng = np.random.default_rng(0)
-    blocks = [np.searchsorted(cdf, rng.random(block_tokens)).astype(np.int32)
-              for _ in range(n_blocks)]
+    blocks = [np.searchsorted(
+        cdf, rng.random(block_tokens * group)).astype(np.int32)
+        for _ in range(n_blocks)]
 
     mv.init([])
     try:
@@ -174,15 +183,18 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4):
                 best = min(best, time.perf_counter() - t0)
             return best
         # every trace bucket is warmed above, so there is no per-run fixed
-        # cost to subtract: best-of-3 average over 16 blocks is the honest
-        # steady-state figure (a 2-point slope doubles the tunnel's
-        # run-to-run latency noise instead of removing anything)
-        k2 = 16
-        per_block = run(k2) / k2
+        # cost to subtract: best-of-reps average over the steady-state
+        # submissions is the honest figure (a 2-point slope doubles the
+        # tunnel's run-to-run latency noise instead of removing anything)
+        k2 = max(16 // group, 8)
+        per_block = run(k2) / (k2 * group)
         stats = trainer.last_block_stats
         return {
             "ps_words_per_sec": round(block_tokens / per_block, 1),
-            "ps_rows_pulled_per_block": stats["in_rows"] + stats["out_rows"],
+            "ps_block_tokens": block_tokens,
+            "ps_block_group": group,
+            "ps_rows_pulled_per_submission": (stats["in_rows"]
+                                              + stats["out_rows"]),
         }
     finally:
         mv.shutdown()
@@ -411,47 +423,81 @@ def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
     }
 
 
-def wait_for_quiet(threshold_gbps=300.0, max_wait_s=120.0, probe_mb=128):
-    """The tunneled TPU is time-shared: sustained external load (minutes,
-    not the seconds-scale bursts the per-section minima already absorb)
-    can depress every figure 2-5x. Probe achieved HBM bandwidth with a
-    small donated-pass loop and, if it is far below the chip's quiet
-    ~760+ GB/s, wait briefly for the load to clear. Bounded: proceeds
-    after ``max_wait_s`` regardless and reports the last probe so a
-    loaded run is at least labeled."""
+def probe_gbps(probe_mb=128):
+    """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
+    donated-pass loop, min-of-3. ~1s; the load thermometer every gated
+    section reads before and after its measurement."""
     import jax
     import jax.numpy as jnp
 
-    if jax.default_backend() != "tpu":
-        return None
     n = probe_mb * 1024 * 1024 // 4
     dense = jax.jit(lambda d: d + 1.0, donate_argnums=(0,))
-    waited = 0.0
-    gbps = 0.0
-    while True:
-        d = dense(jnp.zeros(n, jnp.float32))
+    d = dense(jnp.zeros(n, jnp.float32))
+    _fetch(d[:1])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            d = dense(d)
         _fetch(d[:1])
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(8):
-                d = dense(d)
-            _fetch(d[:1])
-            best = min(best, time.perf_counter() - t0)
-        gbps = 8 * n * 4 * 2 / best / 1e9
-        if gbps >= threshold_gbps or waited >= max_wait_s:
+        best = min(best, time.perf_counter() - t0)
+    return round(8 * n * 4 * 2 / best / 1e9, 1)
+
+
+def run_gated(fn, threshold_gbps=400.0, attempts=3, wait_s=20.0):
+    """Probe-gated section runner (the round-3 verdict's bench-honesty
+    item): the tunneled TPU is time-shared and sustained external load
+    depresses every figure 2-5x, so each section runs up to ``attempts``
+    times and the attempt with the best surrounding (before/after-min)
+    probe wins; an attempt whose probes clear ``threshold_gbps`` is
+    accepted immediately. Returns (result, probe) — the probe is recorded
+    per metric so a loaded figure is at least labeled as such."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return fn(), None
+    best_result, best_probe = None, -1.0
+    for attempt in range(attempts):
+        before = probe_gbps()
+        if before < threshold_gbps and attempt < attempts - 1:
+            time.sleep(wait_s)
+            before = probe_gbps()
+        result = fn()
+        after = probe_gbps()
+        p = min(before, after)
+        if p > best_probe:
+            best_result, best_probe = result, p
+        if p >= threshold_gbps:
             break
+        if attempt < attempts - 1:
+            time.sleep(wait_s)
+    return best_result, round(best_probe, 1)
+
+
+def wait_for_quiet(threshold_gbps=300.0, max_wait_s=120.0):
+    """Pre-run load gate: if the chip is far below its quiet bandwidth,
+    wait briefly for the load to clear. Bounded: proceeds after
+    ``max_wait_s`` regardless and reports the last probe so a loaded run
+    is at least labeled."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    waited = 0.0
+    while True:
+        gbps = probe_gbps()
+        if gbps >= threshold_gbps or waited >= max_wait_s:
+            return gbps
         time.sleep(15.0)
         waited += 15.0
-    return round(gbps, 1)
 
 
 def main():
-    probe_gbps = wait_for_quiet()
-    words_per_sec, final_loss = bench_word2vec()
-    ps = bench_ps_word2vec()
-    matrix = bench_matrix_table()
-    resnet = bench_resnet_asgd()
+    pre_probe = wait_for_quiet()
+    (words_per_sec, final_loss), w2v_probe = run_gated(bench_word2vec)
+    ps, ps_probe = run_gated(bench_ps_word2vec)
+    matrix, matrix_probe = run_gated(bench_matrix_table)
+    resnet, resnet_probe = run_gated(bench_resnet_asgd)
     wire_ratio = bench_wire_compression()
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
@@ -472,10 +518,15 @@ def main():
         **matrix,
         **resnet,
     }
-    if probe_gbps is not None:
-        # pre-run shared-chip load probe (quiet ~760+ GB/s): a low value
-        # labels a run measured under sustained external load
-        result["chip_probe_gbps"] = probe_gbps
+    if pre_probe is not None:
+        # shared-chip load probes (quiet ~760+ GB/s): the pre-run value
+        # plus one per gated section — a low value labels the figure as
+        # measured under sustained external load
+        result["chip_probe_gbps"] = pre_probe
+        result["w2v_probe_gbps"] = w2v_probe
+        result["ps_probe_gbps"] = ps_probe
+        result["matrix_probe_gbps"] = matrix_probe
+        result["resnet_probe_gbps"] = resnet_probe
     print(json.dumps(result))
 
 

@@ -48,7 +48,32 @@ def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
         set_flag(key, value)
     remaining = Zoo.instance().start(argv)
     _configure_native_allocator()
+    _configure_profiling()
     return remaining
+
+
+def _configure_profiling() -> None:
+    """Wire the tracing flags (SURVEY §5's 'host timers plus optional
+    trace annotations'): ``profile_annotations`` makes every
+    ``dashboard.monitor`` section a ``jax.profiler.TraceAnnotation`` so
+    dispatcher device time (SERVER_PROCESS_*) is visible in real traces;
+    ``trace_dir`` additionally starts a profiler trace for the whole
+    init→shutdown span."""
+    trace_dir = str(get_flag("trace_dir"))
+    Dashboard.profile_annotations = bool(
+        get_flag("profile_annotations")) or bool(trace_dir)
+    if trace_dir:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+
+
+def _stop_profiling() -> None:
+    if str(get_flag("trace_dir")):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError:
+            pass  # trace already stopped (repeated shutdown)
 
 
 def _configure_native_allocator() -> None:
@@ -79,6 +104,7 @@ def _configure_native_allocator() -> None:
 
 def shutdown(finalize_net: bool = True) -> None:
     Zoo.instance().stop(finalize_net)
+    _stop_profiling()
 
 
 def barrier() -> None:

@@ -168,6 +168,12 @@ define_string("multihost_endpoint", "",
 define_double("multihost_timeout", 120.0,
               "multihost control-plane connect/barrier timeout (seconds)")
 define_string("mesh_shape", "", "device mesh shape, e.g. '2x4'; empty = auto 1-D")
+define_bool("profile_annotations", False,
+            "wrap dashboard monitor sections in jax.profiler.TraceAnnotation "
+            "so SERVER_PROCESS_* device time shows up in profiler traces")
+define_string("trace_dir", "",
+              "start a jax.profiler trace into this directory at init and "
+              "stop it at shutdown (implies profile_annotations)")
 define_string("mesh_axes", "server", "comma-separated mesh axis names")
 define_bool("deterministic", False,
             "async PS applies adds in (round, worker_id) order so the final "

@@ -196,7 +196,7 @@ int main(void) {
   static const float X[4][2] = {{0, 0}, {0, 1}, {1, 0}, {1, 1}};
   static const float Y[4] = {0, 1, 1, 0};
   for (int s = 0; s < 4; ++s) {
-    float h[2];
+    float h[NH];
     float y = fwd(trained, X[s], h);
     fprintf(stderr, "xor(%g,%g) = %.3f want %g\n", X[s][0], X[s][1], y, Y[s]);
     CHECK(fabsf(y - Y[s]) < 0.35f);

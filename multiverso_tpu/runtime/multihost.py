@@ -358,7 +358,14 @@ class MultihostRuntime:
             listener.listen(self.world)
             listener.settimeout(self._timeout)
             while len(self._conns) < self.world - 1:
-                conn, _addr = listener.accept()
+                try:
+                    conn, _addr = listener.accept()
+                except TimeoutError:
+                    missing = sorted(set(range(1, self.world))
+                                     - set(self._conns))
+                    log.fatal("multihost: follower rank(s) %s never "
+                              "connected to %s within %.0fs", missing,
+                              self._endpoint, self._timeout)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # bound the hello read too: an accepted connection that
                 # never speaks (scanner, half-dead follower) must not

@@ -188,3 +188,33 @@ def test_wire_codec_is_monitored():
     np.testing.assert_array_equal(out["x"], payload["x"])
     assert Dashboard.watch("WIRE_ENCODE").count == 1
     assert Dashboard.watch("WIRE_DECODE").count == 1
+
+
+def test_profiler_trace_annotations(tmp_path):
+    """-trace_dir starts a jax.profiler trace spanning init->shutdown and
+    profile_annotations wraps monitor sections in TraceAnnotation: the
+    dispatcher's SERVER_PROCESS_* section names must appear in the
+    captured trace (SURVEY §5 'host timers plus optional trace
+    annotations')."""
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.dashboard import Dashboard
+
+    trace_dir = tmp_path / "trace"
+    mv.init(local_workers=1, trace_dir=str(trace_dir))
+    try:
+        assert Dashboard.profile_annotations
+        t = mv.create_table("matrix", num_row=16, num_col=4)
+        with mv.worker(0):
+            t.add(np.ones((16, 4), np.float32))
+            t.get()
+    finally:
+        mv.shutdown()
+        mv.set_flag("trace_dir", "")  # flags are sticky across shutdown
+        Dashboard.profile_annotations = False
+    files = list(trace_dir.rglob("*.xplane.pb"))
+    assert files, f"no trace captured under {trace_dir}"
+    blob = b"".join(f.read_bytes() for f in files)
+    assert b"SERVER_PROCESS_ADD_MSG" in blob, (
+        "dispatcher monitor annotation missing from the profiler trace")

@@ -168,6 +168,26 @@ def test_ps_trainer_all_modes_learn(mv_env, mode, objective, lr, epochs):
     assert trainer.count_table.get(0) == trainer.words_trained
 
 
+def test_ps_trainer_grouped_pipelined_learns(mv_env):
+    """train(group=N) — the benched amortization recipe — must converge
+    like ungrouped feeding: the kernel chunks internally at batch_pairs
+    granularity, so only lr-decay granularity coarsens. Word accounting
+    must also stay exact under grouping."""
+    vocab = 30
+    rng = np.random.default_rng(4)
+    corpus = _synthetic_corpus(rng, vocab, n=4000)
+    d = _toy_dictionary(corpus, vocab)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
+                            lr=0.3, batch_pairs=512, sample=0.0)
+    trainer = PSTrainer(config, d)
+    blocks = [corpus[i:i + 500] for i in range(0, len(corpus), 500)]
+    trainer.train(blocks, epochs=10, group=4)
+    score = _cluster_score(trainer.embeddings(), vocab)
+    assert score > 0.2, f"grouped PS trainer failed to learn: {score}"
+    assert trainer.words_trained == len(corpus) * 10
+    assert trainer.count_table.get(0) == trainer.words_trained
+
+
 def test_ps_trainer_adagrad_server_side(mv_env):
     """use_adagrad puts the optimizer on the SERVER (updater_type=adagrad
     tables — the reference's 4-table recipe collapsed into updater state)."""
