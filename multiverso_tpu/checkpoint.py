@@ -38,6 +38,41 @@ def write_array(stream: mv_io.Stream, arr: np.ndarray) -> None:
     stream.write(arr.tobytes())
 
 
+_STATE_MAGIC = b"MVS2"
+
+
+def write_state_dict(stream: mv_io.Stream, states) -> None:
+    """Updater-state trailer (v2 checkpoints): name-keyed arrays after the
+    data frame. The reference's Store hook serialized only table data
+    (table_interface.h:61-75) — optimizer state silently reset on
+    restore; here AdaGrad/momentum/DCASGD accumulators survive, which the
+    resume-exactness test requires."""
+    stream.write(_STATE_MAGIC)
+    names = sorted(states)
+    stream.write(struct.pack("<i", len(names)))
+    for name in names:
+        nb = name.encode("utf-8")
+        stream.write(struct.pack("<B", len(nb)))
+        stream.write(nb)
+        write_array(stream, states[name])
+
+
+def read_state_dict(stream: mv_io.Stream) -> dict:
+    """Read the v2 trailer; {} for v1 checkpoints (data-only) so restores
+    of old snapshots still work — their updater state resets, as it
+    always did."""
+    magic = stream.read(4)
+    if magic != _STATE_MAGIC:
+        return {}
+    (count,) = struct.unpack("<i", stream.read(4))
+    states = {}
+    for _ in range(count):
+        (nlen,) = struct.unpack("<B", stream.read(1))
+        name = stream.read(nlen).decode("utf-8")
+        states[name] = read_array(stream)
+    return states
+
+
 def read_array(stream: mv_io.Stream) -> np.ndarray:
     magic = stream.read(4)
     if magic != _MAGIC:
@@ -64,19 +99,34 @@ def _require_leader(verb: str) -> None:
                   "replay automatically", verb, zoo.rank)
 
 
+def _run_serialized(fn):
+    """Execute ``fn`` on the dispatcher thread, serialized with table
+    traffic. Snapshot/restore MUST order against in-flight adds: async
+    and deferred-apply (deterministic) adds complete to the caller before
+    the device update runs, so a direct app-thread store could capture a
+    mid-application table (caught by the resume-exactness test). Falls
+    back to inline execution when no dispatcher exists (ma mode)."""
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    server = Zoo.instance().server
+    if server is None or not hasattr(server, "run_serialized"):
+        return fn()
+    return server.run_serialized(fn)
+
+
 def store_table(table, address: str) -> None:
     """Store one table (worker or server handle) to a URI."""
     _require_leader("snapshot")
     server = getattr(table, "_server_table", table)
     with mv_io.get_stream(address, "w") as stream:
-        server.store(stream)
+        _run_serialized(lambda: server.store(stream))
 
 
 def load_table(table, address: str) -> None:
     _require_leader("restore")
     server = getattr(table, "_server_table", table)
     with mv_io.get_stream(address, "r") as stream:
-        server.load(stream)
+        _run_serialized(lambda: server.load(stream))
 
 
 class CheckpointDriver:

@@ -162,6 +162,10 @@ define_int("allocator_alignment", 16, "host buffer alignment (native allocator)"
 define_string("allocator_type", "smart", "host allocator: smart|default")
 define_string("machine_file", "", "multi-host machine list (external transport)")
 define_int("port", 55555, "external transport port")
+define_int("wire_quant_bits", 0,
+           "quantize remote ADD deltas to this many bits per value "
+           "(1|2|4|8) with client-side error feedback — the OneBitsFilter "
+           "slot, generalized; 0 disables")
 define_string("multihost_endpoint", "",
               "host:port the leader (JAX process 0) binds for the multihost "
               "lockstep control plane; same value on every process")

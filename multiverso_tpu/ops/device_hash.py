@@ -19,10 +19,12 @@ a statically-shaped jitted program:
 * Slot ``capacity`` is a scratch: masked-out lanes scatter there, so no
   branches and no dynamic shapes anywhere.
 
-Unresolved keys after K rounds are counted in an overflow counter — the
-caller sizes capacity ≥ 2× expected keys (load factor ≤ 0.5, where K=16
-double-hash probes practically never exhaust) and treats overflow > 0 as a
-capacity error. Keys are int32 ≥ 0 (-1 is EMPTY / batch padding); JAX's
+Unresolved keys after K rounds are flagged per lane — their values were
+NOT applied, so the caller (``DeviceKVServer``) can rebuild at a doubled
+capacity and re-insert exactly the flagged lanes (the reference's KV grew
+its unordered_maps unboundedly; here growth is rebuild-and-replay). The
+caller keeps load factor ≤ 0.5, where K=16 double-hash probes practically
+never exhaust. Keys are int32 ≥ 0 (-1 is EMPTY / batch padding); JAX's
 x64-off default makes int64 keys impractical on-device — the host-dict
 KVServer remains for arbitrary-width control-plane keys.
 """
@@ -57,7 +59,9 @@ def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
     """Insert-or-accumulate a batch of UNIQUE keys (pad with -1).
 
     keys/values have length capacity+1 (last slot is scratch). Returns
-    (keys, values, overflow_count)."""
+    (keys, values, overflow_flags) — flags mark live lanes that could
+    not be placed; their values were NOT accumulated, so re-inserting
+    exactly the flagged lanes after a capacity rebuild is lossless."""
     live = batch_keys >= 0
     resolved = ~live
     slot_found = jnp.zeros_like(batch_keys)
@@ -83,7 +87,7 @@ def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
     # scratch slot accumulates masked lanes' garbage; reset it
     keys = keys.at[capacity].set(EMPTY)
     values = values.at[capacity].set(0)
-    overflow = jnp.sum(live & ~resolved)
+    overflow = live & ~resolved
     return keys, values, overflow
 
 

@@ -134,33 +134,6 @@ class _NullSink:
         return len(data)
 
 
-class _WaitResult:
-    """Minimal completion for run_on_dispatcher (kept local to avoid a
-    module cycle with tables.base)."""
-
-    __slots__ = ("_event", "result", "error")
-
-    def __init__(self) -> None:
-        self._event = threading.Event()
-        self.result: Any = None
-        self.error: Optional[BaseException] = None
-
-    def done(self, result: Any) -> None:
-        self.result = result
-        self._event.set()
-
-    def fail(self, error: BaseException) -> None:
-        self.error = error
-        self._event.set()
-
-    def wait(self, timeout: float) -> Any:
-        if not self._event.wait(timeout):
-            raise TimeoutError("dispatcher execution timed out")
-        if self.error is not None:
-            raise self.error
-        return self.result
-
-
 def _is_host_payload(obj: Any) -> bool:
     import numpy as np
     if obj is None or isinstance(obj, (int, float, str, bytes, np.ndarray)):
@@ -436,16 +409,9 @@ class MultihostRuntime:
     # -- leader side -------------------------------------------------------
     def run_on_dispatcher(self, fn: Any) -> Any:
         """Execute ``fn`` on the leader's dispatcher thread, serialized
-        with table traffic, and return its result. If already on the
-        dispatcher thread, run inline (re-entrant store/load)."""
-        if threading.current_thread() is getattr(self._server, "_thread",
-                                                 None):
-            return fn()
-        waiter = _WaitResult()
-        self._server.send(Message(src=-1, dst=-1,
-                                  type=MsgType.Server_Execute,
-                                  data=[fn, waiter]))
-        return waiter.wait(self._timeout)
+        with table traffic (delegates to Server.run_serialized — the
+        shared quiesced-execution primitive; re-entrant)."""
+        return self._server.run_serialized(fn, timeout=self._timeout)
 
     def broadcast_exec(self, op: str, table_id: int, origin: int,
                        msg_id: int, request: Any) -> None:

@@ -41,6 +41,8 @@ from multiverso_tpu.tables.kv_table import KVWorker
 from multiverso_tpu.tables.matrix_table import MatrixWorker
 from multiverso_tpu.tables.sparse_table import SparseWorker
 
+# wire_quant_bits lives in config.py (must exist before this module is
+# first imported so mv.init(wire_quant_bits=...) works)
 config.define_bool("wire_compression", True,
                    "SparseFilter-compress float32 payloads on host hops "
                    "when the sparse form is smaller")
@@ -344,6 +346,16 @@ class RemoteClient:
         return [self.table(s["table_id"]) for s in self.directory]
 
 
+def _make_error_feedback(shape, dtype) -> Optional[Any]:
+    """Per-proxy ErrorFeedback when -wire_quant_bits is set (float32
+    tables only — quantization targets gradient-delta payloads)."""
+    bits = int(config.get_flag("wire_quant_bits"))
+    if bits <= 0 or np.dtype(dtype) != np.float32:
+        return None
+    from multiverso_tpu.utils.quantization import ErrorFeedback
+    return ErrorFeedback(shape, bits)
+
+
 class _RemoteArrayWorker(ArrayWorker):
     """ArrayWorker shaping over the wire (no server construction)."""
 
@@ -352,6 +364,18 @@ class _RemoteArrayWorker(ArrayWorker):
         self.table_id = table_id
         self.size = int(spec["size"])
         self.dtype = np.dtype(spec["dtype"])
+        self._ef = _make_error_feedback((self.size,), self.dtype)
+
+    def _submit(self, msg_type, request):
+        # quantize ADD deltas on the way out (error feedback keeps the
+        # lost precision in the client residual) — the server decodes to
+        # plain float32 before process_add
+        if (self._ef is not None and msg_type == MsgType.Request_Add
+                and isinstance(request, tuple) and len(request) >= 2
+                and isinstance(request[0], np.ndarray)
+                and request[0].dtype == np.float32):
+            request = (self._ef.compress(request[0]),) + request[1:]
+        return super()._submit(msg_type, request)
 
     # device IO is in-process only (a remote hop IS a host hop); without
     # this override the class attribute inherited from ArrayWorker would
@@ -409,9 +433,24 @@ class _RemoteMatrixWorker(MatrixWorker):
         self.num_row = int(spec["num_row"])
         self.num_col = int(spec["num_col"])
         self.dtype = np.dtype(spec["dtype"])
+        self._ef = _make_error_feedback((self.num_row, self.num_col),
+                                        self.dtype)
         self.is_sparse = bool(spec.get("is_sparse", False))
         self._init_client_state(bool(spec.get("is_pipelined", False)),
                                 int(spec.get("num_workers", 1)))
+
+    def _submit(self, msg_type, request):
+        # quantize row-delta ADDs with per-row error feedback (whole-table
+        # adds use ids=None -> full-shape residual). Duplicate row ids in
+        # one batch share a residual read and last-write the update — an
+        # EF approximation; servers dedupe ids anyway
+        if (self._ef is not None and msg_type == MsgType.Request_Add
+                and isinstance(request, tuple) and len(request) == 3
+                and isinstance(request[1], np.ndarray)
+                and request[1].dtype == np.float32):
+            ids, values, option = request
+            request = (ids, self._ef.compress(values, ids), option)
+        return super()._submit(msg_type, request)
 
     def get_device(self):
         raise RuntimeError("get_device() needs mesh residency; remote "

@@ -29,6 +29,34 @@ from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
 
+class _ExecWaiter:
+    """Minimal completion for :meth:`Server.run_serialized` (tables.base's
+    Completion would be an import cycle from here)."""
+
+    __slots__ = ("_event", "result", "error")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def done(self, result) -> None:
+        self.result = result
+        self._event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: float):
+        if not self._event.wait(timeout):
+            raise TimeoutError("dispatcher execution timed out (server "
+                               "stopped?)")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
 class Server:
     """Async parameter server dispatcher (reference: async ``Server``).
 
@@ -73,6 +101,19 @@ class Server:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+
+    def run_serialized(self, fn: Callable, timeout: float = 300.0):
+        """Execute ``fn`` on the dispatcher thread, serialized with table
+        traffic, and return its result — the checkpoint and multihost
+        layers' shared 'quiesced execution' primitive. Re-entrant (runs
+        inline when already on the dispatcher thread); times out rather
+        than hanging if the dispatcher is gone."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        waiter = _ExecWaiter()
+        self.send(Message(src=-1, dst=-1, type=MsgType.Server_Execute,
+                          data=[fn, waiter]))
+        return waiter.wait(timeout)
 
     def register_table(self, server_table) -> int:
         table_id = len(self._tables)

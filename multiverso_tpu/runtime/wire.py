@@ -59,6 +59,14 @@ def _encode(obj: Any, compress: bool) -> List[np.ndarray]:
                           o.rho, o.lambda_]}
         if isinstance(o, GetOption):
             return {"t": "getopt", "v": o.worker_id}
+        from multiverso_tpu.utils.quantization import QuantizedDelta
+        if isinstance(o, QuantizedDelta):
+            # pre-encoded by the client's ErrorFeedback (the OneBits-slot
+            # codec); rides as one uint8 blob, decoded server-side to
+            # plain float32 so process_add never sees the compression
+            blobs.append(np.frombuffer(o.payload, dtype=np.uint8))
+            return {"t": "quant", "i": len(blobs) - 1,
+                    "shape": list(o.shape)}
         if isinstance(o, np.ndarray) or hasattr(o, "__array__"):
             arr = np.ascontiguousarray(np.asarray(o))
             if (compress and arr.dtype == np.float32
@@ -128,6 +136,13 @@ def _decode(blobs: List[np.ndarray]) -> Any:
             shape = tuple(node["shape"])
             count = int(np.prod(shape)) if shape else 1
             flat = sparse_decode(
+                bytes(np.asarray(data[node["i"]], dtype=np.uint8)), count)
+            return flat.reshape(shape)
+        if t == "quant":
+            from multiverso_tpu.utils.quantization import quant_decode
+            shape = tuple(node["shape"])
+            count = int(np.prod(shape)) if shape else 1
+            flat = quant_decode(
                 bytes(np.asarray(data[node["i"]], dtype=np.uint8)), count)
             return flat.reshape(shape)
         if t == "nlist":

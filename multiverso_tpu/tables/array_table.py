@@ -312,17 +312,36 @@ class ArrayServer(ServerTable):
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
-        from multiverso_tpu.checkpoint import write_array
+        from multiverso_tpu.checkpoint import write_array, write_state_dict
         write_array(stream, self._host_read(self.data)[: self.size])
+        write_state_dict(stream, {
+            name: self._host_read(arr)[:, : self.size]
+            for name, arr in self.states.items()})
 
     def load(self, stream) -> None:
-        from multiverso_tpu.checkpoint import read_array
+        from multiverso_tpu.checkpoint import read_array, read_state_dict
         arr = read_array(stream)
         if arr.size != self.size:
             log.fatal("ArrayTable.load: size mismatch %d != %d", arr.size, self.size)
         padded = np.zeros(self.padded, dtype=self.dtype)
         padded[: self.size] = arr.astype(self.dtype)
         self.data = jax.device_put(padded, mesh_lib.table_sharding(self.mesh, ndim=1))
+        loaded = read_state_dict(stream)
+        s_shard = mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=1)
+        for name, cur in self.states.items():
+            got = loaded.get(name)
+            if got is None:
+                continue  # v1 checkpoint: that state resets (pre-v2 behavior)
+            if got.shape[0] != cur.shape[0]:
+                # per-worker state from a world with a different worker
+                # count: elastic restarts keep working — reset like v1
+                log.info("checkpoint: %s worker dim %d != %d; resetting "
+                         "that updater state", name, got.shape[0],
+                         cur.shape[0])
+                continue
+            full = np.zeros(cur.shape, np.dtype(cur.dtype))
+            full[:, : self.size] = got
+            self.states[name] = jax.device_put(full, s_shard)
 
 
 class ArrayWorker(WorkerTable):

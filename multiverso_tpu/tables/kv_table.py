@@ -93,17 +93,34 @@ class DeviceKVServer(ServerTable):
                       "x64-off); got %s — use the host KV table for wider "
                       "types", self.value_dtype)
         self.mesh = zoo.mesh
-        axis = self.mesh.axis_names[0]
+        self._axis = self.mesh.axis_names[0]
         # shards = the size of the ONE mesh axis the shard_map below indexes
         # (axis 0). On a multi-axis table mesh, devices off axis 0 replicate:
         # using zoo.num_servers (total device count) here would make
         # `key % num_shards == axis_index` silently drop every key with
         # residue >= the axis size.
-        self.num_shards = int(self.mesh.shape[axis])
-        per = next_pow2(max(64, -(-int(capacity) // self.num_shards)))
+        self.num_shards = int(self.mesh.shape[self._axis])
+        # exact live count is only known at rebuilds; between them an
+        # upper bound (every batch counted all-new) drives the proactive
+        # load<=0.5 resize in process_add
+        self._live_upper = 0
+        self._alloc(next_pow2(max(64, -(-int(capacity) // self.num_shards))))
+
+    def _alloc(self, per: int) -> None:
+        """(Re)allocate shard arrays at per-shard capacity ``per`` and
+        rebuild the capacity-closed shard_map kernels (growth = fresh
+        arrays + replay; the reference's unordered_map grew implicitly,
+        kv_table.h:19-118)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from multiverso_tpu.ops import device_hash
+        from multiverso_tpu.parallel import mesh as mesh_lib
+
+        axis = self._axis
         self.shard_capacity = per
         self.capacity = per * self.num_shards
-
         sharding = mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0,
                                            axis=axis)
         self.keys = jax.device_put(
@@ -120,7 +137,10 @@ class DeviceKVServer(ServerTable):
             k2, v2, ovf = device_hash.hash_add(
                 keys_l[0], vals_l[0], jnp.where(mine, bk, -1),
                 jnp.where(mine, bv, 0), per)
-            return k2[None], v2[None], ovf[None]
+            # every live lane belongs to exactly one shard: the psum
+            # yields the global per-lane overflow flags, replicated
+            return k2[None], v2[None], jax.lax.psum(
+                ovf.astype(jnp.int32), axis)
 
         def get_body(keys_l, vals_l, bk):
             idx = jax.lax.axis_index(axis)
@@ -132,7 +152,7 @@ class DeviceKVServer(ServerTable):
         self._add = jax.jit(jax.shard_map(
             add_body, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(), P()),
-            out_specs=(P(axis), P(axis), P(axis))), donate_argnums=(0, 1))
+            out_specs=(P(axis), P(axis), P())), donate_argnums=(0, 1))
         self._get = jax.jit(jax.shard_map(
             get_body, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P()), out_specs=P()))
@@ -145,7 +165,6 @@ class DeviceKVServer(ServerTable):
         return out
 
     def process_add(self, request) -> None:
-        import jax.numpy as jnp
         keys, values, _option = request
         keys = np.asarray(keys, dtype=np.int64).reshape(-1)
         if keys.size and keys.min() < 0:
@@ -156,13 +175,60 @@ class DeviceKVServer(ServerTable):
         ukeys, inv = np.unique(keys.astype(np.int32), return_inverse=True)
         uvals = np.zeros(len(ukeys), self.value_dtype)
         np.add.at(uvals, inv, vals)
+        self._insert(ukeys, uvals)
+
+    def _insert(self, ukeys: np.ndarray, uvals: np.ndarray,
+                depth: int = 0) -> None:
+        """Insert unique (key, value) pairs, growing the table as needed.
+
+        Proactive: if the live-count upper bound plus this batch would
+        push the load factor past 0.5, rebuild bigger FIRST. Reactive:
+        probe exhaustion still flags unplaced lanes (values unapplied),
+        which re-insert after a doubling rebuild — lossless by the
+        hash_add contract."""
+        import jax.numpy as jnp
+        if depth > 8:
+            log.fatal("DeviceKV growth did not converge after %d rebuilds "
+                      "(capacity=%d, batch=%d)", depth, self.capacity,
+                      len(ukeys))
+        if 2 * (self._live_upper + len(ukeys)) > self.capacity:
+            self._grow(self._live_upper + len(ukeys))
         bk = jnp.asarray(self._bucket(ukeys, -1, np.int32))
         bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
-        self.keys, self.values, ovf = self._add(self.keys, self.values, bk, bv)
-        n_ovf = int(self._host_read(ovf).sum())
-        if n_ovf:
-            log.fatal("DeviceKV capacity exhausted (%d keys overflowed; "
-                      "capacity=%d)", n_ovf, self.capacity)
+        self.keys, self.values, ovf = self._add(self.keys, self.values,
+                                                bk, bv)
+        self._live_upper += len(ukeys)
+        flags = self._host_read(ovf)[: len(ukeys)] > 0
+        if flags.any():
+            self._grow(self._live_upper + int(flags.sum()))
+            self._insert(ukeys[flags], uvals[flags], depth + 1)
+
+    def _grow(self, need: int) -> None:
+        """Rebuild at a capacity giving >=2x headroom over ``need`` live
+        keys and replay the live pairs (one jitted re-insert per rebuild;
+        also resets the live-count upper bound to the exact figure)."""
+        import jax.numpy as jnp
+        pairs = self.process_get((None, None))
+        per = next_pow2(max(
+            64,
+            -(-2 * max(need, len(pairs) + 1) // self.num_shards),
+            2 * self.shard_capacity))
+        log.info("DeviceKV grow: %d live keys, capacity %d -> %d",
+                 len(pairs), self.capacity, per * self.num_shards)
+        self._alloc(per)
+        self._live_upper = len(pairs)
+        if pairs:
+            rk = np.fromiter(pairs.keys(), np.int32, len(pairs))
+            rv = np.fromiter(pairs.values(), self.value_dtype, len(pairs))
+            bk = jnp.asarray(self._bucket(rk, -1, np.int32))
+            bv = jnp.asarray(self._bucket(rv, 0, self.value_dtype))
+            self.keys, self.values, ovf = self._add(self.keys, self.values,
+                                                    bk, bv)
+            if (self._host_read(ovf)[: len(rk)] > 0).any():
+                # 2x headroom per shard should never exhaust 16 probes;
+                # if the key distribution is that adversarial, stop
+                log.fatal("DeviceKV rebuild overflowed its own replay "
+                          "(%d keys, capacity %d)", len(rk), self.capacity)
 
     def process_get(self, request):
         import jax
@@ -200,18 +266,11 @@ class DeviceKVServer(ServerTable):
             (keys[i],) = struct.unpack("<q", stream.read(8))
             vals[i] = np.frombuffer(stream.read(item),
                                     dtype=self.value_dtype)[0]
-        # reset and replay
-        import jax
-        from multiverso_tpu.ops import device_hash
-        from multiverso_tpu.parallel import mesh as mesh_lib
-        sharding = mesh_lib.table_sharding(
-            self.mesh, ndim=2, shard_dim=0, axis=self.mesh.axis_names[0])
-        self.keys = jax.device_put(
-            np.full((self.num_shards, self.shard_capacity + 1),
-                    device_hash.EMPTY, np.int32), sharding)
-        self.values = jax.device_put(
-            np.zeros((self.num_shards, self.shard_capacity + 1),
-                     self.value_dtype), sharding)
+        # reset (fresh arrays + kernels) and replay through the growing
+        # insert path — a snapshot larger than the current capacity
+        # simply triggers a rebuild
+        self._alloc(self.shard_capacity)
+        self._live_upper = 0
         if count:
             self.process_add((keys, vals, None))
 

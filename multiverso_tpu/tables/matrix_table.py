@@ -372,18 +372,47 @@ class MatrixServer(ServerTable):
 
     # -- checkpoint --------------------------------------------------------
     def store(self, stream) -> None:
-        from multiverso_tpu.checkpoint import write_array
+        from multiverso_tpu.checkpoint import write_array, write_state_dict
         write_array(stream,
                     self._host_read(self.data)[: self.num_row,
                                                : self.num_col])
+        # updater state sliced to logical dims (padding is a function of
+        # the restoring mesh, not checkpoint content)
+        write_state_dict(stream, {
+            name: self._host_read(arr)[:, : self.num_row, : self.num_col]
+            for name, arr in self.states.items()})
 
     def load(self, stream) -> None:
-        from multiverso_tpu.checkpoint import read_array
+        from multiverso_tpu.checkpoint import read_array, read_state_dict
         arr = read_array(stream).astype(self.dtype).reshape(self.num_row, self.num_col)
         padded = np.zeros((self.padded_rows, self.padded_cols), dtype=self.dtype)
         padded[: self.num_row, : self.num_col] = arr
         self.data = jax.device_put(
             padded, mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0))
+        loaded = read_state_dict(stream)
+        s_shard = mesh_lib.table_sharding(self.mesh, ndim=3, shard_dim=1)
+        for name, cur in self.states.items():
+            got = loaded.get(name)
+            if got is None:
+                continue  # v1 checkpoint: that state resets (pre-v2 behavior)
+            if got.shape[0] != cur.shape[0]:
+                # per-worker state from a world with a different worker
+                # count: elastic restarts keep working — reset like v1
+                log.info("checkpoint: %s worker dim %d != %d; resetting "
+                         "that updater state", name, got.shape[0],
+                         cur.shape[0])
+                continue
+            full = np.zeros(cur.shape, np.dtype(cur.dtype))
+            full[:, : self.num_row, : self.num_col] = got
+            self.states[name] = jax.device_put(full, s_shard)
+        if self.is_sparse:
+            # staleness is NOT restorable state: it certifies worker-side
+            # client caches the snapshot does not cover — a restored
+            # table must serve every row fresh once (values re-pulled,
+            # resume-exactness preserved; claiming freshness against
+            # unknown caches would serve stale rows silently)
+            with self._std_lock:
+                self._up_to_date[:, :] = False
 
 
 class MatrixWorker(WorkerTable):

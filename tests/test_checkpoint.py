@@ -71,3 +71,124 @@ def test_driver_restore_empty_dir(mv_env, tmp_path):
     driver = CheckpointDriver([table], str(tmp_path / "empty"))
     assert driver.restore() is False
     driver.close()
+
+
+def _train_rounds(table, deltas, lr=0.1):
+    from multiverso_tpu.updaters import AddOption
+    for i, d in enumerate(deltas):
+        opt = AddOption(worker_id=0, learning_rate=lr)
+        table.add(d, option=opt)
+
+
+def test_resume_exactness_adagrad(tmp_path):
+    """train k -> snapshot -> restore in a FRESH Zoo -> continue must be
+    BITWISE identical to uninterrupted training: requires the v2
+    checkpoint trailer carrying the AdaGrad accumulators (the reference's
+    Store hook dropped optimizer state, table_interface.h:61-75 — parity
+    with that bug was explicitly not the bar, round-3 verdict)."""
+    rng = np.random.default_rng(5)
+    deltas = [rng.normal(size=30).astype(np.float32) for _ in range(10)]
+    path = str(tmp_path / "resume.mvckpt")
+
+    # uninterrupted run
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("array", 30, np.float32, "adagrad")
+    _train_rounds(t, deltas)
+    want = t.get()
+    mv.shutdown()
+
+    # interrupted: 5 rounds, snapshot, fresh world, restore, 5 more
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("array", 30, np.float32, "adagrad")
+    _train_rounds(t, deltas[:5])
+    store_table(t, path)
+    mv.shutdown()
+
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("array", 30, np.float32, "adagrad")
+    load_table(t, path)
+    _train_rounds(t, deltas[5:])
+    got = t.get()
+    mv.shutdown()
+    mv.set_flag("deterministic", False)  # flags are sticky in-process
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resume_exactness_matrix_momentum(tmp_path):
+    """Same resume≡uninterrupted bar for MatrixTable with momentum state
+    (row-subset adds so the state slicing/padding round-trip is hit)."""
+    rng = np.random.default_rng(6)
+    rounds = []
+    for _ in range(8):
+        ids = np.sort(rng.choice(12, 4, replace=False)).astype(np.int32)
+        rounds.append((ids, rng.normal(size=(4, 5)).astype(np.float32)))
+    path = str(tmp_path / "resume_m.mvckpt")
+
+    def play(table, batch):
+        from multiverso_tpu.updaters import AddOption
+        for ids, vals in batch:
+            table.add(vals, row_ids=ids,
+                      option=AddOption(worker_id=0, learning_rate=0.05,
+                                       momentum=0.9))
+
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("matrix", 12, 5, np.float32, "momentum_sgd")
+    play(t, rounds)
+    want = t.get()
+    mv.shutdown()
+
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("matrix", 12, 5, np.float32, "momentum_sgd")
+    play(t, rounds[:4])
+    store_table(t, path)
+    mv.shutdown()
+
+    mv.init(local_workers=1, deterministic=True)
+    t = mv.create_table("matrix", 12, 5, np.float32, "momentum_sgd")
+    load_table(t, path)
+    play(t, rounds[4:])
+    got = t.get()
+    mv.shutdown()
+    mv.set_flag("deterministic", False)  # flags are sticky in-process
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sparse_table_load_invalidates_staleness(tmp_path):
+    """After a restore every row must be served stale-once: the snapshot
+    does not cover worker-side client caches, so claiming freshness would
+    silently serve pre-restore rows from them."""
+    path = str(tmp_path / "sparse.mvckpt")
+    mv.init(local_workers=1)
+    t = mv.create_table("matrix", 8, 3, np.float32, is_sparse=True)
+    with mv.worker(0):
+        t.add(np.ones((8, 3), np.float32))
+        t.get()          # warms this worker's cache + marks rows fresh
+        store_table(t, path)
+        load_table(t, path)
+        before = t.rows_pulled
+        got = t.get()    # must re-pull ALL rows, not trust the old planes
+        assert t.rows_pulled - before == 8
+        np.testing.assert_allclose(got, 1.0)
+    mv.shutdown()
+
+
+def test_restore_with_different_worker_count_resets_state(tmp_path):
+    """Elastic restart: per-worker updater state (DCASGD backups) from a
+    4-worker snapshot restores into a 2-worker world by RESETTING that
+    state (v1 behavior) instead of crashing; table data still loads."""
+    path = str(tmp_path / "elastic.mvckpt")
+    mv.init(local_workers=4)
+    t = mv.create_table("array", 10, np.float32, "dcasgd")
+    with mv.worker(0):
+        t.add(np.ones(10, np.float32))
+        want = t.get()
+    store_table(t, path)
+    mv.shutdown()
+
+    mv.init(local_workers=2)
+    t2 = mv.create_table("array", 10, np.float32, "dcasgd")
+    load_table(t2, path)
+    with mv.worker(0):
+        got = t2.get()
+    mv.shutdown()
+    np.testing.assert_allclose(got, want)

@@ -436,3 +436,91 @@ def test_remote_matrix_refuses_device_io():
         rt.add_device_async(None, np.array([1], np.int32))
     client.close()
     mv.shutdown()
+
+
+def test_quant_codec_roundtrip_and_native_parity():
+    """1/2/4/8-bit quant codec: decode error bounded by step/2, and the
+    native C++ packer must be byte-identical to the numpy fallback
+    (same contract SparseFilter holds)."""
+    from multiverso_tpu.utils import quantization as q
+
+    rng = np.random.default_rng(0)
+    for bits in (1, 2, 4, 8):
+        for n in (1, 7, 64, 1000):
+            x = (rng.normal(size=n) * 3).astype(np.float32)
+            via_np = q.quant_encode(x, bits, force_numpy=True)
+            payload = q.quant_encode(x, bits)
+            if q.native_available():
+                assert payload == via_np, f"native != numpy at bits={bits}"
+            dec_np = q.quant_decode(via_np, n, force_numpy=True)
+            dec = q.quant_decode(payload, n)
+            np.testing.assert_array_equal(dec, dec_np)
+            step = np.frombuffer(via_np, np.float32, 1, offset=20)[0]
+            assert np.abs(dec - x).max() <= step / 2 + 1e-6
+        # constant array: step == 0, decodes exactly
+        c = np.full(33, 2.5, np.float32)
+        np.testing.assert_array_equal(
+            q.quant_decode(q.quant_encode(c, bits), 33), c)
+
+
+def test_quant_wire_compression_ratio_and_error_feedback_convergence():
+    """The OneBits-slot completion (round-3 verdict #6): remote SGD with
+    4-bit quantized pushes + error feedback must (a) shrink ADD payloads
+    ~8x and (b) reach the same final loss as uncompressed pushes on the
+    same logreg problem."""
+    from multiverso_tpu.runtime import wire
+    from multiverso_tpu.utils.quantization import QuantizedDelta
+
+    rng = np.random.default_rng(3)
+    dim = 32
+    X = rng.normal(size=(256, dim)).astype(np.float32)
+    true_w = rng.normal(size=dim).astype(np.float32)
+    y = (X @ true_w > 0).astype(np.float32)
+
+    def loss_of(w):
+        z = X @ w
+        p = 1.0 / (1.0 + np.exp(-z))
+        eps = 1e-7
+        return float(-np.mean(y * np.log(p + eps)
+                              + (1 - y) * np.log(1 - p + eps)))
+
+    def train(bits):
+        mv.set_flag("wire_quant_bits", bits)
+        try:
+            mv.init(remote_workers=1)
+            table = mv.create_table("array", dim, np.float32)
+            endpoint = mv.serve("127.0.0.1:0")
+            client = mv.remote_connect(endpoint)
+            t = client.table(table.table_id)
+            for _ in range(120):
+                w = np.asarray(t.get(), np.float32)
+                z = X @ w
+                p = 1.0 / (1.0 + np.exp(-z))
+                grad = X.T @ (p - y) / len(y)
+                t.add((-0.5 * grad).astype(np.float32))
+            final = np.asarray(t.get(), np.float32)
+            client.close()
+            return loss_of(final)
+        finally:
+            mv.shutdown()
+            mv.set_flag("wire_quant_bits", 0)
+
+    base = train(0)
+    quant = train(4)
+    assert quant < base + 0.05, (
+        f"4-bit EF training diverged: {quant} vs {base}")
+
+    # measured wire shrinkage on a representative delta payload
+    delta = rng.normal(size=(64, 128)).astype(np.float32)
+    plain = sum(np.asarray(b).nbytes
+                for b in wire.encode((None, delta, None)))
+    from multiverso_tpu.utils.quantization import ErrorFeedback
+    ef = ErrorFeedback(delta.shape, 4)
+    qblobs = wire.encode((None, ef.compress(delta), None))
+    qsize = sum(np.asarray(b).nbytes for b in qblobs)
+    ratio = plain / qsize
+    assert ratio > 6.0, f"4-bit codec only shrank {ratio:.1f}x"
+    # and the tagged payload decodes server-side to the dequantized delta
+    _, dec, _ = wire.decode(qblobs)
+    assert dec.shape == delta.shape
+    assert np.abs(dec - delta).max() < np.abs(delta).max()
