@@ -111,7 +111,9 @@ def _run_serialized(fn):
     server = Zoo.instance().server
     if server is None or not hasattr(server, "run_serialized"):
         return fn()
-    return server.run_serialized(fn)
+    # unbounded: a timeout would close the caller's stream while the
+    # dispatcher is mid-write, leaving a truncated snapshot behind
+    return server.run_serialized(fn, timeout=None)
 
 
 def store_table(table, address: str) -> None:

@@ -48,7 +48,7 @@ class _ExecWaiter:
         self.error = error
         self._event.set()
 
-    def wait(self, timeout: float):
+    def wait(self, timeout: Optional[float]):
         if not self._event.wait(timeout):
             raise TimeoutError("dispatcher execution timed out (server "
                                "stopped?)")
@@ -102,12 +102,14 @@ class Server:
             self._thread.join(timeout=30)
             self._thread = None
 
-    def run_serialized(self, fn: Callable, timeout: float = 300.0):
+    def run_serialized(self, fn: Callable,
+                       timeout: Optional[float] = 300.0):
         """Execute ``fn`` on the dispatcher thread, serialized with table
         traffic, and return its result — the checkpoint and multihost
         layers' shared 'quiesced execution' primitive. Re-entrant (runs
-        inline when already on the dispatcher thread); times out rather
-        than hanging if the dispatcher is gone."""
+        inline when already on the dispatcher thread). ``timeout=None``
+        waits unbounded — callers whose fn legitimately runs long (multi-GB
+        checkpoint streams) must not be cut off mid-write."""
         if threading.current_thread() is self._thread:
             return fn()
         waiter = _ExecWaiter()

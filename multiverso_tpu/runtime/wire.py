@@ -26,6 +26,7 @@ import numpy as np
 
 from multiverso_tpu.dashboard import monitor
 from multiverso_tpu.updaters import AddOption, GetOption
+from multiverso_tpu.utils.quantization import QuantizedDelta
 
 # arrays below this size never win from sparse encoding (header overhead)
 _COMPRESS_MIN_SIZE = 64
@@ -59,7 +60,6 @@ def _encode(obj: Any, compress: bool) -> List[np.ndarray]:
                           o.rho, o.lambda_]}
         if isinstance(o, GetOption):
             return {"t": "getopt", "v": o.worker_id}
-        from multiverso_tpu.utils.quantization import QuantizedDelta
         if isinstance(o, QuantizedDelta):
             # pre-encoded by the client's ErrorFeedback (the OneBits-slot
             # codec); rides as one uint8 blob, decoded server-side to

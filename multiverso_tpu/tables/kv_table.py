@@ -192,7 +192,12 @@ class DeviceKVServer(ServerTable):
                       "(capacity=%d, batch=%d)", depth, self.capacity,
                       len(ukeys))
         if 2 * (self._live_upper + len(ukeys)) > self.capacity:
-            self._grow(self._live_upper + len(ukeys))
+            # the upper bound is duplicates-blind (a steady-state job
+            # re-adding one key set would inflate it forever): refresh the
+            # EXACT live count first, grow only if genuinely needed
+            self._live_upper = len(self.process_get((None, None)))
+            if 2 * (self._live_upper + len(ukeys)) > self.capacity:
+                self._grow(self._live_upper + len(ukeys))
         bk = jnp.asarray(self._bucket(ukeys, -1, np.int32))
         bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
         self.keys, self.values, ovf = self._add(self.keys, self.values,
@@ -200,19 +205,23 @@ class DeviceKVServer(ServerTable):
         self._live_upper += len(ukeys)
         flags = self._host_read(ovf)[: len(ukeys)] > 0
         if flags.any():
-            self._grow(self._live_upper + int(flags.sum()))
+            # real probe exhaustion: force at least a doubling
+            self._grow(self._live_upper + int(flags.sum()),
+                       force_double=True)
             self._insert(ukeys[flags], uvals[flags], depth + 1)
 
-    def _grow(self, need: int) -> None:
+    def _grow(self, need: int, force_double: bool = False) -> None:
         """Rebuild at a capacity giving >=2x headroom over ``need`` live
         keys and replay the live pairs (one jitted re-insert per rebuild;
-        also resets the live-count upper bound to the exact figure)."""
+        also resets the live-count upper bound to the exact figure).
+        ``force_double`` (reactive overflow path) guarantees progress even
+        when the headroom math alone would keep the same size."""
         import jax.numpy as jnp
         pairs = self.process_get((None, None))
         per = next_pow2(max(
             64,
             -(-2 * max(need, len(pairs) + 1) // self.num_shards),
-            2 * self.shard_capacity))
+            2 * self.shard_capacity if force_double else 0))
         log.info("DeviceKV grow: %d live keys, capacity %d -> %d",
                  len(pairs), self.capacity, per * self.num_shards)
         self._alloc(per)

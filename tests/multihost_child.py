@@ -43,6 +43,8 @@ def main() -> int:
         run_bsp(mv, np, rank, world)
     elif scenario == "checkpoint":
         run_checkpoint(mv, np, rank, world)
+    elif scenario == "w2v":
+        run_w2v(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -112,6 +114,42 @@ def run_checkpoint(mv, np, rank: int, world: int) -> None:
         np.testing.assert_allclose(
             mat.get(), np.full((rows, cols), base, np.float32),
             err_msg="restore did not rebuild pre-snapshot state")
+    mv.process_barrier()
+
+
+def run_w2v(mv, np, rank: int, world: int) -> None:
+    """A REAL app rides the multihost mesh: each process's PSTrainer
+    trains its corpus shard against ONE pair of globally-sharded
+    embedding tables (the reference's multi-rank WordEmbedding shape).
+    Tables are created collectively by constructing identical trainers;
+    the staged host pull/push path forwards through the leader."""
+    from multiverso_tpu.models.vocab import Dictionary
+    from multiverso_tpu.models.word2vec import PSTrainer, Word2VecConfig
+
+    vocab = 120
+    rng = np.random.default_rng(0)  # same corpus plan on every rank
+    corpus = rng.integers(0, vocab, size=4000).astype(np.int32)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(np.bincount(corpus, minlength=vocab), 1)
+    config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=3,
+                            batch_pairs=512, sample=0.0)
+    trainer = PSTrainer(config, d)  # collective table creation
+    shard = corpus[rank::world]
+    with mv.worker(0):
+        for i in range(0, len(shard), 500):
+            loss = trainer.train_block(shard[i:i + 500])
+            assert np.isfinite(loss), loss
+    mv.process_barrier()
+    with mv.worker(0):
+        emb = trainer.embeddings()
+        assert emb.shape == (vocab, config.dim)
+        assert np.isfinite(emb).all()
+        # the shared word-count table saw EVERY rank's words
+        total = trainer.count_table.get(0)
+    expected = sum(len(corpus[r::world]) for r in range(world))
+    assert total == expected, (total, expected)
     mv.process_barrier()
 
 

@@ -148,3 +148,18 @@ def test_device_kv_grow_preserves_accumulation_semantics(mv_env):
     table.add(list(range(10, 400)), [0.5] * 390)
     table.add([1, 2, 3], [10.0, 20.0, 30.0])
     assert table.get([1, 2, 3]) == [11.0, 22.0, 33.0]
+
+
+def test_device_kv_steady_state_does_not_grow_unboundedly(mv_env):
+    """Re-adding one fixed key set forever must NOT inflate capacity:
+    the proactive resize refreshes the exact live count before growing
+    (review finding: the duplicates-blind upper bound alone doubled
+    capacity ~2x per total adds ever)."""
+    table = mv.create_table("kv", np.int32, capacity=256)
+    server = table._server_table
+    keys = list(range(100))
+    for _ in range(40):  # 4000 total adds of the SAME 100 keys
+        table.add(keys, [1] * 100)
+    assert server.capacity <= 1024, (
+        f"steady-state workload grew capacity to {server.capacity}")
+    assert table.get([0, 50, 99]) == [40, 40, 40]

@@ -34,3 +34,18 @@ def test_multihost_checkpoint_snapshot_restore():
     restore broadcasts the checkpoint bytes so every process rebuilds
     identical device state."""
     spawn_lockstep_world(_CHILD, "checkpoint")
+
+
+def test_multihost_three_process_world():
+    """World=3 (leader + 2 followers, 2 devices each -> one 6-device
+    global mesh): the lockstep barrier arithmetic, ack routing, and
+    sharded add/get must hold beyond the 2-process base case."""
+    spawn_lockstep_world(_CHILD, "async", world=3, devices_per_proc=2)
+
+
+def test_multihost_ps_word2vec_app():
+    """The flagship app across processes: two PSTrainers on two JAX
+    processes train corpus shards against one globally-sharded embedding
+    table pair; the shared word-count table proves both ranks' traffic
+    landed."""
+    spawn_lockstep_world(_CHILD, "w2v", timeout=600)

@@ -175,3 +175,64 @@ def test_deterministic_server_apply_order():
         assert not t.is_alive()
     np.testing.assert_array_equal(table.get(), expected)
     mv.shutdown()
+
+
+def test_aggregate_device_path_sums_in_hbm():
+    """MV_Aggregate device path (round-3 verdict 'aggregate is
+    host-bound'): jax.Array inputs reduce as one jitted tree-sum and the
+    result STAYS on device; lists of leaves (a model) work too."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    mv.init(local_workers=3)
+    results = {}
+
+    def work(slot):
+        with mv.worker(slot):
+            leaf_a = jnp.full((8,), float(slot + 1))
+            leaf_b = jnp.full((2, 2), float(10 * (slot + 1)))
+            results[slot] = mv.aggregate([leaf_a, leaf_b])
+
+    threads = [threading.Thread(target=work, args=(s,)) for s in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    mv.shutdown()
+    for slot in range(3):
+        out_a, out_b = results[slot]
+        assert isinstance(out_a, jax.Array)  # never left the device
+        np.testing.assert_allclose(np.asarray(out_a), 6.0)
+        np.testing.assert_allclose(np.asarray(out_b), 60.0)
+
+
+def test_aggregate_rejects_mixed_host_device():
+    import threading
+
+    import jax.numpy as jnp
+
+    from multiverso_tpu.log import FatalError
+
+    mv.init(local_workers=2)
+    errors = {}
+
+    def work(slot):
+        with mv.worker(slot):
+            try:
+                val = (jnp.ones(4) if slot == 0
+                       else np.ones(4, np.float32))
+                mv.aggregate(val)
+            except (FatalError, threading.BrokenBarrierError) as exc:
+                # slot 0 (the reducer) gets the fatal; peers get released
+                # with BrokenBarrierError instead of hanging
+                errors[slot] = exc
+
+    threads = [threading.Thread(target=work, args=(s,)) for s in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    mv.shutdown()
+    assert errors, "mixed host/device aggregate was not rejected"
