@@ -115,7 +115,7 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
 
 
 def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4,
-                      group=8):
+                      group=16):
     """End-to-end parameter-server words/sec: the full product path —
     candidate-row pulls through the dispatcher, compact-space scan training,
     delta pushes through the updater (the reference's only benchmarked

@@ -177,9 +177,15 @@ def worker(local_slot: int) -> Iterator[int]:
 
 # -- collectives (MV_Aggregate) ---------------------------------------------
 
-def aggregate(data: np.ndarray) -> np.ndarray:
-    """Elementwise sum of ``data`` across every worker; every caller gets the
-    summed result (in-place-sum semantics of ``MV_Aggregate``)."""
+def aggregate(data: Any) -> Any:
+    """Elementwise sum of ``data`` across every worker; every caller gets
+    the summed result (in-place-sum semantics of ``MV_Aggregate``).
+
+    Host inputs (numpy arrays, or lists of them — a model's leaves) sum
+    on the host and return copies. DEVICE inputs (``jax.Array`` or a
+    list of them) reduce as ONE jitted tree-sum in HBM and the result
+    stays on device — the MA-mode fast path; mixing host and device
+    values across workers in one round is rejected."""
     return Zoo.instance().aggregate(data)
 
 

@@ -59,12 +59,18 @@ def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
     """Insert-or-accumulate a batch of UNIQUE keys (pad with -1).
 
     keys/values have length capacity+1 (last slot is scratch). Returns
-    (keys, values, overflow_flags) — flags mark live lanes that could
-    not be placed; their values were NOT accumulated, so re-inserting
-    exactly the flagged lanes after a capacity rebuild is lossless."""
+    (keys, values, overflow_flags, inserted_count):
+
+    * ``overflow_flags`` mark live lanes that could not be placed; their
+      values were NOT accumulated, so re-inserting exactly the flagged
+      lanes after a capacity rebuild is lossless;
+    * ``inserted_count`` is the number of lanes that claimed a NEW slot
+      (vs accumulating into an existing key) — the caller's exact live
+      counter, which keeps growth decisions scan-free."""
     live = batch_keys >= 0
     resolved = ~live
     slot_found = jnp.zeros_like(batch_keys)
+    inserted = jnp.zeros(batch_keys.shape, bool)
 
     # static unroll: under shard_map a fori_loop carry would mix varying
     # (sharded keys) and unvarying (batch) types, which scan rejects
@@ -80,6 +86,9 @@ def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
             jnp.where(claimable, batch_keys, EMPTY))
         confirmed = keys[cand] == batch_keys
         won = (match | claimable) & confirmed & ~resolved
+        # a lane that won through a CLAIM (cur was EMPTY, so match was
+        # False) occupies a fresh slot
+        inserted = inserted | (claimable & won)
         slot_found = jnp.where(won, cand, slot_found)
         resolved = resolved | won
     vidx = jnp.where(resolved & live, slot_found, capacity)
@@ -88,7 +97,7 @@ def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
     keys = keys.at[capacity].set(EMPTY)
     values = values.at[capacity].set(0)
     overflow = live & ~resolved
-    return keys, values, overflow
+    return keys, values, overflow, jnp.sum(inserted.astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("capacity",))

@@ -108,14 +108,24 @@ class Server:
         traffic, and return its result — the checkpoint and multihost
         layers' shared 'quiesced execution' primitive. Re-entrant (runs
         inline when already on the dispatcher thread). ``timeout=None``
-        waits unbounded — callers whose fn legitimately runs long (multi-GB
-        checkpoint streams) must not be cut off mid-write."""
-        if threading.current_thread() is self._thread:
+        waits as long as the dispatcher LIVES — callers whose fn
+        legitimately runs long (multi-GB checkpoint streams) are not cut
+        off mid-write, but a stopped/dead dispatcher raises instead of
+        hanging the caller forever."""
+        thread = self._thread
+        if threading.current_thread() is thread:
             return fn()
         waiter = _ExecWaiter()
         self.send(Message(src=-1, dst=-1, type=MsgType.Server_Execute,
                           data=[fn, waiter]))
-        return waiter.wait(timeout)
+        if timeout is not None:
+            return waiter.wait(timeout)
+        while not waiter._event.wait(10.0):
+            if thread is None or not thread.is_alive():
+                raise TimeoutError(
+                    "dispatcher exited with the serialized execution "
+                    "still pending (server stopped?)")
+        return waiter.wait(0)
 
     def register_table(self, server_table) -> int:
         table_id = len(self._tables)

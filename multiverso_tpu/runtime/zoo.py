@@ -50,6 +50,28 @@ config.define_int("remote_workers", 0,
 _thread_local = threading.local()
 
 
+def _is_device_value(v: Any) -> bool:
+    """A jax.Array, or a non-empty list/tuple of them (a model's leaves)
+    — the aggregate device path's input shape."""
+    import jax
+
+    return isinstance(v, jax.Array) or (
+        isinstance(v, (list, tuple)) and bool(v)
+        and all(isinstance(x, jax.Array) for x in v))
+
+
+def _host_leaf_sum(values):
+    """Per-leaf numpy sums across workers' leaf lists; ragged lists fail
+    loudly (inside the aggregate barrier-abort guard) instead of silently
+    dropping trailing leaves."""
+    lengths = {len(v) for v in values}
+    if len(lengths) > 1:
+        log.fatal("aggregate: workers deposited leaf lists of different "
+                  "lengths (%s)", sorted(lengths))
+    return [np.sum([np.asarray(v[i]) for v in values], axis=0)
+            for i in range(len(values[0]))]
+
+
 class Zoo:
     """Process-wide runtime singleton."""
 
@@ -291,12 +313,7 @@ class Zoo:
         host buffers, the round-3 verdict's 'aggregate is host-bound'
         item). Mixed host/device calls across workers in one round are
         rejected."""
-        import jax
-
-        is_device = isinstance(data, jax.Array) or (
-            isinstance(data, (list, tuple)) and data
-            and all(isinstance(x, jax.Array) for x in data))
-        if is_device:
+        if _is_device_value(data):
             # device results are immutable jax.Arrays: every worker can
             # share the same buffers, no defensive copy
             return self._aggregate_slots(data, self._device_sum,
@@ -308,10 +325,7 @@ class Zoo:
             # happens in the reducer, inside the barrier-abort guard — a
             # ragged value must fail loudly, not wedge peers pre-deposit
             return self._aggregate_slots(
-                data,
-                lambda values: [np.sum([np.asarray(v[i]) for v in values],
-                                       axis=0)
-                                for i in range(len(values[0]))],
+                data, _host_leaf_sum,
                 copy=lambda r: [np.array(x, copy=True) for x in r])
         return self._aggregate_slots(
             data,
@@ -343,14 +357,7 @@ class Zoo:
                 with self._agg_lock:
                     values = list(self._agg_slots.values())
                     self._agg_slots.clear()
-                import jax
-
-                def _dev(v):
-                    return isinstance(v, jax.Array) or (
-                        isinstance(v, (list, tuple)) and v
-                        and all(isinstance(x, jax.Array) for x in v))
-
-                if len({_dev(v) for v in values}) > 1:
+                if len({_is_device_value(v) for v in values}) > 1:
                     log.fatal("aggregate: workers mixed host and device "
                               "values in one round")
                 self._agg_result = reduce_fn(values)
@@ -365,6 +372,11 @@ class Zoo:
         result = self._agg_result
         if self._barrier is not None and self._local_workers > 1:
             self._barrier.wait()
+        if local == 0:
+            # every worker took its reference between the barriers: drop
+            # the registry's pin so a device-path sum doesn't stay
+            # resident in HBM until the next aggregate round
+            self._agg_result = None
         return copy(result)
 
     def _device_sum(self, values):

@@ -100,10 +100,9 @@ class DeviceKVServer(ServerTable):
         # `key % num_shards == axis_index` silently drop every key with
         # residue >= the axis size.
         self.num_shards = int(self.mesh.shape[self._axis])
-        # exact live count is only known at rebuilds; between them an
-        # upper bound (every batch counted all-new) drives the proactive
-        # load<=0.5 resize in process_add
-        self._live_upper = 0
+        # exact live count: hash_add reports newly-inserted slots per
+        # batch (and rebuilds recount), so growth decisions never scan
+        self._live = 0
         self._alloc(next_pow2(max(64, -(-int(capacity) // self.num_shards))))
 
     def _alloc(self, per: int) -> None:
@@ -134,13 +133,15 @@ class DeviceKVServer(ServerTable):
         def add_body(keys_l, vals_l, bk, bv):
             idx = jax.lax.axis_index(axis)
             mine = (bk >= 0) & (bk % num_shards == idx)
-            k2, v2, ovf = device_hash.hash_add(
+            k2, v2, ovf, ins = device_hash.hash_add(
                 keys_l[0], vals_l[0], jnp.where(mine, bk, -1),
                 jnp.where(mine, bv, 0), per)
-            # every live lane belongs to exactly one shard: the psum
-            # yields the global per-lane overflow flags, replicated
-            return k2[None], v2[None], jax.lax.psum(
-                ovf.astype(jnp.int32), axis)
+            # every live lane belongs to exactly one shard: the psums
+            # yield the global per-lane overflow flags and the global
+            # newly-inserted count, replicated
+            return (k2[None], v2[None],
+                    jax.lax.psum(ovf.astype(jnp.int32), axis),
+                    jax.lax.psum(ins, axis))
 
         def get_body(keys_l, vals_l, bk):
             idx = jax.lax.axis_index(axis)
@@ -152,7 +153,7 @@ class DeviceKVServer(ServerTable):
         self._add = jax.jit(jax.shard_map(
             add_body, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P(), P()),
-            out_specs=(P(axis), P(axis), P())), donate_argnums=(0, 1))
+            out_specs=(P(axis), P(axis), P(), P())), donate_argnums=(0, 1))
         self._get = jax.jit(jax.shard_map(
             get_body, mesh=self.mesh,
             in_specs=(P(axis), P(axis), P()), out_specs=P()))
@@ -181,58 +182,56 @@ class DeviceKVServer(ServerTable):
                 depth: int = 0) -> None:
         """Insert unique (key, value) pairs, growing the table as needed.
 
-        Proactive: if the live-count upper bound plus this batch would
-        push the load factor past 0.5, rebuild bigger FIRST. Reactive:
-        probe exhaustion still flags unplaced lanes (values unapplied),
-        which re-insert after a doubling rebuild — lossless by the
-        hash_add contract."""
+        Proactive: if the exact live count plus this batch (worst case
+        all-new) would push the load factor past 0.5, rebuild bigger
+        FIRST. Reactive: probe exhaustion still flags unplaced lanes
+        (values unapplied), which re-insert after a doubling rebuild —
+        lossless by the hash_add contract."""
         import jax.numpy as jnp
         if depth > 8:
             log.fatal("DeviceKV growth did not converge after %d rebuilds "
                       "(capacity=%d, batch=%d)", depth, self.capacity,
                       len(ukeys))
-        if 2 * (self._live_upper + len(ukeys)) > self.capacity:
-            # the upper bound is duplicates-blind (a steady-state job
-            # re-adding one key set would inflate it forever): refresh the
-            # EXACT live count first, grow only if genuinely needed
-            self._live_upper = len(self.process_get((None, None)))
-            if 2 * (self._live_upper + len(ukeys)) > self.capacity:
-                self._grow(self._live_upper + len(ukeys))
+        if 2 * (self._live + len(ukeys)) > self.capacity:
+            self._grow(self._live + len(ukeys))
         bk = jnp.asarray(self._bucket(ukeys, -1, np.int32))
         bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
-        self.keys, self.values, ovf = self._add(self.keys, self.values,
-                                                bk, bv)
-        self._live_upper += len(ukeys)
+        self.keys, self.values, ovf, ins = self._add(self.keys, self.values,
+                                                     bk, bv)
         flags = self._host_read(ovf)[: len(ukeys)] > 0
+        self._live += int(self._host_read(ins))
         if flags.any():
             # real probe exhaustion: force at least a doubling
-            self._grow(self._live_upper + int(flags.sum()),
-                       force_double=True)
+            self._grow(self._live + int(flags.sum()), force_double=True)
             self._insert(ukeys[flags], uvals[flags], depth + 1)
 
     def _grow(self, need: int, force_double: bool = False) -> None:
         """Rebuild at a capacity giving >=2x headroom over ``need`` live
         keys and replay the live pairs (one jitted re-insert per rebuild;
-        also resets the live-count upper bound to the exact figure).
+        also recounts the live figure exactly).
         ``force_double`` (reactive overflow path) guarantees progress even
         when the headroom math alone would keep the same size."""
         import jax.numpy as jnp
         pairs = self.process_get((None, None))
+        # 4x headroom (load <= 0.25): the batch claim protocol retries one
+        # slot per probe round, so contention can exhaust MAX_PROBE well
+        # before 0.5 load — sizing generously avoids rebuild churn (HBM
+        # cost is two scalars per slot)
         per = next_pow2(max(
             64,
-            -(-2 * max(need, len(pairs) + 1) // self.num_shards),
+            -(-4 * max(need, len(pairs) + 1) // self.num_shards),
             2 * self.shard_capacity if force_double else 0))
         log.info("DeviceKV grow: %d live keys, capacity %d -> %d",
                  len(pairs), self.capacity, per * self.num_shards)
         self._alloc(per)
-        self._live_upper = len(pairs)
+        self._live = len(pairs)
         if pairs:
             rk = np.fromiter(pairs.keys(), np.int32, len(pairs))
             rv = np.fromiter(pairs.values(), self.value_dtype, len(pairs))
             bk = jnp.asarray(self._bucket(rk, -1, np.int32))
             bv = jnp.asarray(self._bucket(rv, 0, self.value_dtype))
-            self.keys, self.values, ovf = self._add(self.keys, self.values,
-                                                    bk, bv)
+            self.keys, self.values, ovf, _ins = self._add(
+                self.keys, self.values, bk, bv)
             if (self._host_read(ovf)[: len(rk)] > 0).any():
                 # 2x headroom per shard should never exhaust 16 probes;
                 # if the key distribution is that adversarial, stop
@@ -279,7 +278,7 @@ class DeviceKVServer(ServerTable):
         # insert path — a snapshot larger than the current capacity
         # simply triggers a rebuild
         self._alloc(self.shard_capacity)
-        self._live_upper = 0
+        self._live = 0
         if count:
             self.process_add((keys, vals, None))
 

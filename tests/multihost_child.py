@@ -30,9 +30,10 @@ def main() -> int:
     import numpy as np
     import multiverso_tpu as mv
 
-    flags = dict(local_workers=1, remote_workers=0,
+    flags = dict(local_workers=2 if scenario == "bsp2" else 1,
+                 remote_workers=0,
                  multihost_endpoint=f"127.0.0.1:{ctl_port}",
-                 sync=scenario == "bsp")
+                 sync=scenario in ("bsp", "bsp2"))
     mv.init(**flags)
     assert jax.device_count() > jax.local_device_count(), \
         "mesh does not span processes"
@@ -45,6 +46,8 @@ def main() -> int:
         run_checkpoint(mv, np, rank, world)
     elif scenario == "w2v":
         run_w2v(mv, np, rank, world)
+    elif scenario == "bsp2":
+        run_bsp2(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -150,6 +153,44 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
         total = trainer.count_table.get(0)
     expected = sum(len(corpus[r::world]) for r in range(world))
     assert total == expected, (total, expected)
+    mv.process_barrier()
+
+
+def run_bsp2(mv, np, rank: int, world: int) -> None:
+    """BSP with TWO worker threads per process (4 global workers over 2
+    processes): global worker ids are rank*local_workers+slot, and the
+    round contract must hold across the full 2x2 worker grid."""
+    import threading
+
+    rows, cols = 16, 4
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    rounds, workers = 3, 2 * world
+    errors = []
+
+    def work(slot):
+        try:
+            with mv.worker(slot):
+                wid = rank * 2 + slot
+                for i in range(1, rounds + 1):
+                    mat.add(np.full((rows, cols), float(wid + 1),
+                                    np.float32))
+                    got = mat.get()
+                    np.testing.assert_allclose(
+                        got, np.full((rows, cols),
+                                     i * sum(range(1, workers + 1)),
+                                     np.float32),
+                        err_msg=f"worker {wid} round {i}")
+                mat.finish_train()
+        except Exception as exc:  # surfaced by the parent assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(s,)) for s in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads), "worker thread hung"
     mv.process_barrier()
 
 

@@ -49,3 +49,10 @@ def test_multihost_ps_word2vec_app():
     table pair; the shared word-count table proves both ranks' traffic
     landed."""
     spawn_lockstep_world(_CHILD, "w2v", timeout=600)
+
+
+def test_multihost_bsp_two_workers_per_process():
+    """BSP with 2 worker threads per process x 2 processes: the round
+    contract holds over the full 4-worker grid (global ids
+    rank*local_workers+slot)."""
+    spawn_lockstep_world(_CHILD, "bsp2")
