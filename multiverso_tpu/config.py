@@ -149,6 +149,10 @@ parse_cmd_flags = FLAGS.parse_cmd_flags
 define_string("ps_role", "default", "node role: worker|server|default(all)|none")
 define_bool("ma", False, "model-averaging mode: skip PS tables, aggregate() only")
 define_bool("sync", False, "synchronous (BSP) parameter server")
+define_int("ssp_staleness", -1,
+           "stale-synchronous-parallel bound: a worker's Get waits until "
+           "every unfinished worker is within this many add-rounds of it "
+           "(0 = BSP-like read gate; -1 disables). Ignored when sync=True")
 define_double("backup_worker_ratio", 0.0,
               "fraction of workers treated as backups: the BSP round gates "
               "ignore the slowest floor(ratio*num_workers) workers' clocks")

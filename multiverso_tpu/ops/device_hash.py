@@ -55,7 +55,7 @@ def _probe_slot(key: jax.Array, probe, capacity: int) -> jax.Array:
 @partial(jax.jit, static_argnames=("capacity",), donate_argnums=(0, 1))
 def hash_add(keys: jax.Array, values: jax.Array, batch_keys: jax.Array,
              batch_values: jax.Array, capacity: int
-             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+             ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Insert-or-accumulate a batch of UNIQUE keys (pad with -1).
 
     keys/values have length capacity+1 (last slot is scratch). Returns

@@ -229,7 +229,8 @@ class FollowerServer:
         self._tables: Dict[int, Any] = {}
         # the leader's server semantics, recomputed from the (identical)
         # flags — clients consult these capability bits
-        self.gates_gets = bool(config.get_flag("sync"))
+        self.gates_gets = (bool(config.get_flag("sync"))
+                           or int(config.get_flag("ssp_staleness")) >= 0)
         self.defers_adds = (not self.gates_gets
                             and bool(config.get_flag("deterministic")))
 

@@ -448,12 +448,82 @@ class SyncServer(Server):
             self._pending_add[table_id] = still
 
 
+class SSPServer(SyncServer):
+    """Stale-Synchronous-Parallel dispatcher — BEYOND the reference
+    (SURVEY §2.2 notes bounded staleness was absent upstream; SSP was the
+    Petuum-era consistency point between async and BSP).
+
+    Contract: a worker that has completed ``r`` Adds on a table may Get
+    that table only once EVERY unfinished worker has completed at least
+    ``r - staleness`` Adds — the fastest worker runs at most ``staleness``
+    rounds ahead of the slowest. ``staleness=0`` degenerates to a
+    BSP-like read gate; large staleness approaches pure async. Adds are
+    never deferred (unlike BSP's two-sided clock): applying a straggler's
+    delta cannot violate anyone's staleness bound, it only advances the
+    gate. ``backup_worker_ratio`` composes — backups are excluded from
+    the minimum like in BSP."""
+
+    gates_gets = True
+
+    def __init__(self, num_workers: int, staleness: int) -> None:
+        super().__init__(num_workers)
+        self.staleness = int(staleness)
+
+    def _process_add(self, msg: Message) -> None:
+        tid = msg.table_id
+        worker = msg.src
+        if self._is_admin(worker):
+            super(SyncServer, self)._process_add(msg)
+            return
+        request, completion = msg.data
+        completion.done(self._tables[tid].process_add(request))
+        self._add_clock[tid][worker] += 1
+        self._drain(tid)
+
+    def _gate_round(self, tid: int, worker: int) -> int:
+        """The add-round this worker's next Get requires every unfinished
+        (non-backup) worker to have reached."""
+        return self._add_clock[tid][worker] - self.staleness
+
+    def _process_get(self, msg: Message) -> None:
+        tid = msg.table_id
+        worker = msg.src
+        if self._is_admin(worker):
+            super(SyncServer, self)._process_get(msg)
+            return
+        if self._min_adds(tid) >= self._gate_round(tid, worker):
+            request, completion = msg.data
+            result = self._tables[tid].process_get(request)
+            self._get_clock[tid][worker] += 1
+            completion.done(result)
+        else:
+            self._pending_get[tid].append(msg)
+
+    def _drain(self, table_id: int) -> None:
+        still: List[Message] = []
+        for msg in self._pending_get[table_id]:
+            worker = msg.src
+            if self._min_adds(table_id) >= self._gate_round(table_id,
+                                                            worker):
+                request, completion = msg.data
+                result = self._tables[table_id].process_get(request)
+                self._get_clock[table_id][worker] += 1
+                completion.done(result)
+            else:
+                still.append(msg)
+        self._pending_get[table_id] = still
+
+
 def make_server(num_workers: int) -> Server:
-    """Factory keyed on the ``sync`` flag (reference: ``Server::GetServer``);
-    the ``deterministic`` flag selects the reproducible-apply-order async
-    server (sync mode is already deterministic through its clocks)."""
+    """Factory keyed on the consistency flags (reference:
+    ``Server::GetServer``): ``sync`` → BSP, ``ssp_staleness >= 0`` →
+    bounded staleness, ``deterministic`` → reproducible-apply-order async
+    (sync mode is already deterministic through its clocks)."""
     if config.get_flag("sync"):
         return SyncServer(num_workers)
+    ssp = int(config.get_flag("ssp_staleness"))
+    if ssp >= 0:
+        return SSPServer(num_workers, ssp)
     if config.get_flag("deterministic"):
         return DeterministicServer(num_workers)
     return Server(num_workers)

@@ -198,15 +198,20 @@ class DeviceKVServer(ServerTable):
         bv = jnp.asarray(self._bucket(uvals, 0, self.value_dtype))
         self.keys, self.values, ovf, ins = self._add(self.keys, self.values,
                                                      bk, bv)
-        flags = self._host_read(ovf)[: len(ukeys)] > 0
-        self._live += int(self._host_read(ins))
+        # ONE host fetch for both scalars/flags: they are replicated
+        # (out_specs P()), so a plain device_get is multihost-safe and a
+        # second blocking round trip would be pure latency on the add path
+        import jax
+        ovf_h, ins_h = jax.device_get((ovf, ins))
+        flags = np.asarray(ovf_h)[: len(ukeys)] > 0
+        self._live += int(ins_h)
         if flags.any():
             # real probe exhaustion: force at least a doubling
             self._grow(self._live + int(flags.sum()), force_double=True)
             self._insert(ukeys[flags], uvals[flags], depth + 1)
 
     def _grow(self, need: int, force_double: bool = False) -> None:
-        """Rebuild at a capacity giving >=2x headroom over ``need`` live
+        """Rebuild at a capacity giving >=4x headroom over ``need`` live
         keys and replay the live pairs (one jitted re-insert per rebuild;
         also recounts the live figure exactly).
         ``force_double`` (reactive overflow path) guarantees progress even
@@ -233,7 +238,7 @@ class DeviceKVServer(ServerTable):
             self.keys, self.values, ovf, _ins = self._add(
                 self.keys, self.values, bk, bv)
             if (self._host_read(ovf)[: len(rk)] > 0).any():
-                # 2x headroom per shard should never exhaust 16 probes;
+                # 4x headroom per shard should never exhaust 16 probes;
                 # if the key distribution is that adversarial, stop
                 log.fatal("DeviceKV rebuild overflowed its own replay "
                           "(%d keys, capacity %d)", len(rk), self.capacity)

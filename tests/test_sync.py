@@ -153,3 +153,108 @@ def test_async_mode_no_round_blocking(mv_env):
     for _ in range(10):
         table.add(np.ones(4, np.float32))
     np.testing.assert_allclose(table.get(), np.full(4, 10.0))
+
+
+def test_ssp_staleness_window_allows_bounded_lead():
+    """SSP (beyond the reference — bounded staleness was absent upstream):
+    with staleness=1, a fast worker may run ONE round ahead of the
+    slowest without blocking, and its round-r get reflects at least
+    round r-1 of every worker's adds."""
+    workers, rounds, size, s = 3, 6, 8, 1
+    mv.init(ssp_staleness=s, local_workers=workers, sync=False)
+    try:
+        table = mv.create_table("array", size, np.float32)
+        results = {}
+
+        def run(slot):
+            with mv.worker(slot):
+                out = []
+                for _ in range(rounds):
+                    table.add(np.ones(size, np.float32))
+                    out.append(table.get().copy())
+                table.finish_train()
+                results[slot] = out
+
+        _run_workers(workers, run)
+        for slot, outs in results.items():
+            for i, val in enumerate(outs):
+                # round-(i+1) get: every worker has >= i+1-s adds applied,
+                # and no worker can have more than rounds adds
+                lo = ((i + 1) + max(i + 1 - s, 0) * (workers - 1)) * 1.0
+                hi = float(rounds * workers)
+                assert lo <= val[0] <= hi, (
+                    f"worker {slot} round {i}: {val[0]} not in "
+                    f"[{lo},{hi}]")
+    finally:
+        mv.shutdown()
+        mv.set_flag("ssp_staleness", -1)
+
+
+def test_ssp_zero_staleness_matches_bsp_read_contract():
+    """staleness=0: every round-r get observes at least r rounds of every
+    worker's adds (the BSP read bound), still without add deferral."""
+    workers, rounds, size = 3, 4, 4
+    mv.init(ssp_staleness=0, local_workers=workers, sync=False)
+    try:
+        table = mv.create_table("array", size, np.float32)
+        results = {}
+
+        def run(slot):
+            with mv.worker(slot):
+                out = []
+                for _ in range(rounds):
+                    table.add(np.ones(size, np.float32))
+                    out.append(table.get().copy())
+                table.finish_train()
+                results[slot] = out
+
+        _run_workers(workers, run)
+        for slot, outs in results.items():
+            for i, val in enumerate(outs):
+                assert val[0] >= (i + 1) * workers - 0.5, (
+                    f"worker {slot} round {i} observed {val[0]} < "
+                    f"{(i + 1) * workers}")
+    finally:
+        mv.shutdown()
+        mv.set_flag("ssp_staleness", -1)
+
+
+def test_ssp_fast_worker_blocks_beyond_staleness():
+    """The bound is REAL: with staleness=1 and a deliberately stalled
+    peer, a fast worker's third get must block until the peer advances —
+    verified by ordering, not sleeps."""
+    import time
+
+    mv.init(ssp_staleness=1, local_workers=2, sync=False)
+    try:
+        table = mv.create_table("array", 4, np.float32)
+        events = []
+        slow_may_continue = threading.Event()
+
+        def fast():
+            with mv.worker(0):
+                table.add(np.ones(4, np.float32))
+                table.get()            # round 1, needs min_adds >= 0
+                table.add(np.ones(4, np.float32))
+                table.get()            # round 2, needs min_adds >= 1
+                events.append("fast-before-release")
+                slow_may_continue.set()  # let the peer advance...
+                table.add(np.ones(4, np.float32))
+                table.get()            # round 3, needs min_adds >= 2
+                events.append("fast-after-round3")
+
+        def slow():
+            with mv.worker(1):
+                table.add(np.ones(4, np.float32))  # round 1
+                slow_may_continue.wait(30)
+                time.sleep(0.2)        # fast's round-3 get must be parked
+                events.append("slow-advancing")
+                table.add(np.ones(4, np.float32))  # round 2 releases fast
+                table.finish_train()
+
+        _run_workers(2, lambda s: [fast, slow][s]())
+        assert events.index("slow-advancing") < events.index(
+            "fast-after-round3"), events
+    finally:
+        mv.shutdown()
+        mv.set_flag("ssp_staleness", -1)

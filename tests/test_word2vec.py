@@ -471,3 +471,44 @@ def test_ps_txn_refused_under_bsp():
                 lambda datas, states: (datas, states, None), [])
     finally:
         mv.shutdown()
+
+
+def test_ps_trainer_under_ssp_staleness():
+    """PS trainers under the SSP server: two workers train shards with a
+    staleness-2 bound and still learn (the staged pull/push path is
+    gated per-table, so equal block counts per worker line the clocks
+    up)."""
+    import threading
+
+    vocab = 30
+    rng = np.random.default_rng(9)
+    corpus = _synthetic_corpus(rng, vocab, n=4000)
+    d = _toy_dictionary(corpus, vocab)
+    mv.init(ssp_staleness=2, local_workers=2, sync=False)
+    try:
+        config = Word2VecConfig(vocab_size=vocab, dim=16, window=2,
+                                negatives=4, lr=0.3, batch_pairs=512,
+                                sample=0.0)
+        trainer = PSTrainer(config, d)
+        blocks = [corpus[i:i + 500] for i in range(0, len(corpus), 500)]
+
+        def run(slot):
+            with mv.worker(slot):
+                for _ in range(8):
+                    for b in blocks[slot::2]:
+                        trainer.train_block(b)
+                trainer.input_table.finish_train()
+                trainer.output_table.finish_train()
+
+        threads = [threading.Thread(target=run, args=(s,))
+                   for s in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=240)
+        assert not any(t.is_alive() for t in threads), "SSP deadlock"
+        score = _cluster_score(trainer.embeddings(), vocab)
+        assert score > 0.15, f"SSP PS training failed to learn: {score}"
+    finally:
+        mv.shutdown()
+        mv.set_flag("ssp_staleness", -1)
