@@ -19,6 +19,7 @@ check: native test dryrun bench
 native:
 	$(MAKE) -C multiverso_tpu/native
 	$(MAKE) -C multiverso_tpu/native test_c_api CC=gcc
+	$(MAKE) -C multiverso_tpu/native test_lua_ffi CC=gcc
 
 test: native
 	$(PYTHON) -m pytest tests/ -x -q

@@ -31,7 +31,10 @@ def main() -> int:
     import multiverso_tpu as mv
 
     flags = dict(local_workers=2 if scenario == "bsp2" else 1,
-                 remote_workers=0,
+                 # remote slot expectations are part of num_workers and
+                 # must MATCH across processes (table worker dims shape
+                 # the collective programs)
+                 remote_workers=1 if scenario == "remote" else 0,
                  multihost_endpoint=f"127.0.0.1:{ctl_port}",
                  sync=scenario in ("bsp", "bsp2"))
     mv.init(**flags)
@@ -48,6 +51,8 @@ def main() -> int:
         run_w2v(mv, np, rank, world)
     elif scenario == "bsp2":
         run_bsp2(mv, np, rank, world)
+    elif scenario == "remote":
+        run_remote(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -153,6 +158,32 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
         total = trainer.count_table.get(0)
     expected = sum(len(corpus[r::world]) for r in range(world))
     assert total == expected, (total, expected)
+    mv.process_barrier()
+
+
+def run_remote(mv, np, rank: int, world: int) -> None:
+    """The FULL scaling topology at once: a table sharded across BOTH
+    processes' devices (multihost mesh) ALSO served to an off-mesh
+    remote client over TCP from the leader — mesh workers, follower
+    workers, and wire clients all hit the same lockstep dispatcher."""
+    rows, cols = 24, 6
+    expect = sum(range(1, world + 1)) + 10.0  # mesh adds + wire client add
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    with mv.worker(0):
+        mat.add(np.full((rows, cols), float(rank + 1), np.float32))
+    mv.process_barrier()
+    if rank == 0:
+        endpoint = mv.serve("127.0.0.1:0")
+        client = mv.remote_connect(endpoint)
+        rt = client.table(mat.table_id)
+        rt.add(np.full((rows, cols), 10.0, np.float32))
+        got = np.asarray(rt.get())
+        client.close()
+        np.testing.assert_allclose(got, expect)
+    mv.process_barrier()
+    with mv.worker(0):
+        got = mat.get()  # every mesh rank sees the wire client's add too
+    np.testing.assert_allclose(got, expect)
     mv.process_barrier()
 
 

@@ -56,3 +56,11 @@ def test_multihost_bsp_two_workers_per_process():
     contract holds over the full 4-worker grid (global ids
     rank*local_workers+slot)."""
     spawn_lockstep_world(_CHILD, "bsp2")
+
+
+def test_multihost_with_offmesh_remote_client():
+    """The complete scaling topology: a multihost-sharded table ALSO
+    served to an off-mesh TCP client from the leader — mesh workers,
+    follower workers, and wire clients hit one lockstep dispatcher and
+    all observe each other's adds."""
+    spawn_lockstep_world(_CHILD, "remote")
