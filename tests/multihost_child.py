@@ -53,6 +53,8 @@ def main() -> int:
         run_bsp2(mv, np, rank, world)
     elif scenario == "remote":
         run_remote(mv, np, rank, world)
+    elif scenario == "crash":
+        run_crash(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -159,6 +161,33 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
     expected = sum(len(corpus[r::world]) for r in range(world))
     assert total == expected, (total, expected)
     mv.process_barrier()
+
+
+def run_crash(mv, np, rank: int, world: int) -> None:
+    """Failure detection: rank 1 dies abruptly mid-run; the leader's next
+    collective must fail LOUDLY within the Gloo deadline instead of
+    hanging forever (the reference had no failure detection at all —
+    SURVEY §5 'a send failure is a CHECK/Fatal')."""
+    import os as _os
+    import time
+
+    mat = mv.create_table("matrix", num_row=16, num_col=4)
+    with mv.worker(0):
+        mat.add(np.ones((16, 4), np.float32))
+        mat.get()
+    mv.process_barrier()
+    if rank == 1:
+        _os._exit(42)  # simulated host failure: no goodbye, no cleanup
+    time.sleep(1.0)  # let the death land
+    try:
+        with mv.worker(0):
+            mat.add(np.ones((16, 4), np.float32))
+            mat.get()  # collective against a dead peer
+    except BaseException as exc:  # noqa: BLE001 — any loud failure is the pass
+        print(f"LEADER_DETECTED_FAILURE {type(exc).__name__}", flush=True)
+        _os._exit(0)
+    print("LEADER_DID_NOT_DETECT_FAILURE", flush=True)
+    _os._exit(1)
 
 
 def run_remote(mv, np, rank: int, world: int) -> None:
