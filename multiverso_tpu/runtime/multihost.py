@@ -39,7 +39,7 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple  # noqa: F401
 
 from multiverso_tpu import config, log
 from multiverso_tpu.runtime.message import Message, MsgType
@@ -598,13 +598,18 @@ class MultihostRuntime:
 
 def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
                          devices_per_proc: int = 4,
-                         timeout: float = 300.0) -> List[str]:
+                         timeout: float = 300.0,
+                         expect: Optional[Dict[int, Tuple[int,
+                                                          Optional[str]]]]
+                         = None) -> List[str]:
     """Launch ``world`` OS processes running ``child_script`` (rank, world,
     coordinator port, control port, scenario argv) with per-process virtual
     CPU devices — the shared harness behind tests/test_multihost.py and
     __graft_entry__.dryrun_multichip's multiprocess leg. Returns each
     rank's combined output; raises RuntimeError on any failure or missing
-    OK marker."""
+    OK marker. ``expect`` overrides the (returncode, required-marker)
+    expectation per rank — ``(42, None)`` accepts a deliberately-crashed
+    rank (failure-injection scenarios)."""
     import os
     import subprocess
     import sys
@@ -643,7 +648,11 @@ def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
             if p.poll() is None:
                 p.kill()
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0 or f"MULTIHOST_CHILD_OK rank={rank}" not in out:
+        want_rc, want_marker = (expect or {}).get(
+            rank, (0, f"MULTIHOST_CHILD_OK rank={rank}"))
+        if p.returncode != want_rc or (want_marker is not None
+                                       and want_marker not in out):
             raise RuntimeError(f"lockstep world rank {rank} failed "
-                               f"(rc={p.returncode}):\n{out}")
+                               f"(rc={p.returncode}, want {want_rc} with "
+                               f"{want_marker!r}):\n{out}")
     return outs

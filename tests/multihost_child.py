@@ -178,14 +178,20 @@ def run_crash(mv, np, rank: int, world: int) -> None:
     mv.process_barrier()
     if rank == 1:
         _os._exit(42)  # simulated host failure: no goodbye, no cleanup
-    time.sleep(1.0)  # let the death land
-    try:
-        with mv.worker(0):
-            mat.add(np.ones((16, 4), np.float32))
-            mat.get()  # collective against a dead peer
-    except BaseException as exc:  # noqa: BLE001 — any loud failure is the pass
-        print(f"LEADER_DETECTED_FAILURE {type(exc).__name__}", flush=True)
-        _os._exit(0)
+    # observation-based, not sleep-based: keep issuing collectives until
+    # the dead peer surfaces as an error (bounded by the deadline) — a
+    # fixed sleep would race a slow-to-die peer
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            with mv.worker(0):
+                mat.add(np.ones((16, 4), np.float32))
+                mat.get()
+            time.sleep(0.5)
+        except BaseException as exc:  # noqa: BLE001 — loud failure = pass
+            print(f"LEADER_DETECTED_FAILURE {type(exc).__name__}",
+                  flush=True)
+            _os._exit(0)
     print("LEADER_DID_NOT_DETECT_FAILURE", flush=True)
     _os._exit(1)
 

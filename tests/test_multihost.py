@@ -70,39 +70,7 @@ def test_multihost_follower_crash_detected_loudly():
     """A follower dying mid-run (simulated host failure) must surface as
     a bounded-time loud error on the leader — not a silent hang. The
     leader prints LEADER_DETECTED_FAILURE and exits 0; the dead rank
-    exits 42 by design, so the shared spawner is not used here."""
-    import os
-    import socket
-    import subprocess
-    import sys
-
-    def free_port() -> int:
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        return port
-
-    coord, ctl = free_port(), free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    env["PYTHONPATH"] = str(Path(_CHILD).parent.parent)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _CHILD, str(rank), "2", str(coord), str(ctl),
-             "crash"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for rank in range(2)
-    ]
-    try:
-        out0, _ = procs[0].communicate(timeout=240)
-        procs[1].wait(timeout=30)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    assert procs[1].returncode == 42  # the simulated crash
-    assert procs[0].returncode == 0, out0[-2000:]
-    assert "LEADER_DETECTED_FAILURE" in out0, out0[-2000:]
+    exits 42 by design (expressed via the shared spawner's ``expect``)."""
+    spawn_lockstep_world(
+        _CHILD, "crash", devices_per_proc=2,
+        expect={0: (0, "LEADER_DETECTED_FAILURE"), 1: (42, None)})
