@@ -39,7 +39,7 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple  # noqa: F401
+from typing import Any, Dict, List, Optional, Tuple
 
 from multiverso_tpu import config, log
 from multiverso_tpu.runtime.message import Message, MsgType

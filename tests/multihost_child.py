@@ -179,20 +179,39 @@ def run_crash(mv, np, rank: int, world: int) -> None:
     if rank == 1:
         _os._exit(42)  # simulated host failure: no goodbye, no cleanup
     # observation-based, not sleep-based: keep issuing collectives until
-    # the dead peer surfaces as an error (bounded by the deadline) — a
-    # fixed sleep would race a slow-to-die peer
+    # the dead peer surfaces as an error. Each attempt runs on its own
+    # watchdogged thread so a SILENTLY-HANGING collective — the exact
+    # regression this test guards — is reported as non-detection within
+    # the deadline instead of wedging until the harness kill
+    import threading
+
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
-        try:
-            with mv.worker(0):
-                mat.add(np.ones((16, 4), np.float32))
-                mat.get()
-            time.sleep(0.5)
-        except BaseException as exc:  # noqa: BLE001 — loud failure = pass
-            print(f"LEADER_DETECTED_FAILURE {type(exc).__name__}",
+        outcome = {}
+
+        def attempt():
+            try:
+                with mv.worker(0):
+                    mat.add(np.ones((16, 4), np.float32))
+                    mat.get()
+                outcome["ok"] = True
+            except BaseException as exc:  # noqa: BLE001 — loud = pass
+                outcome["exc"] = exc
+
+        t = threading.Thread(target=attempt, daemon=True)
+        t.start()
+        t.join(timeout=60)
+        if t.is_alive():
+            print("LEADER_DID_NOT_DETECT_FAILURE (collective hung)",
                   flush=True)
+            _os._exit(1)
+        if "exc" in outcome:
+            print("LEADER_DETECTED_FAILURE "
+                  f"{type(outcome['exc']).__name__}", flush=True)
             _os._exit(0)
-    print("LEADER_DID_NOT_DETECT_FAILURE", flush=True)
+        time.sleep(0.5)  # peer still alive; retry
+    print("LEADER_DID_NOT_DETECT_FAILURE (no error before deadline)",
+          flush=True)
     _os._exit(1)
 
 
