@@ -55,6 +55,8 @@ def main() -> int:
         run_remote(mv, np, rank, world)
     elif scenario == "crash":
         run_crash(mv, np, rank, world)
+    elif scenario == "kv":
+        run_kv(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -163,6 +165,26 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
     mv.process_barrier()
 
 
+def run_kv(mv, np, rank: int, world: int) -> None:
+    """DeviceKV (the lightLDA-shaped sparse store) across processes: the
+    shard_map hash kernels run as global collectives, and GROWTH — a
+    collective rebuild + replay — happens in lockstep on every process."""
+    kv = mv.create_table("kv", np.int32, capacity=64)  # tiny: forces growth
+    cap0 = kv._server_table.capacity  # per-shard minimums inflate this
+    n_keys = cap0  # enough unique keys that load>0.5 forces a rebuild
+    with mv.worker(0):
+        # overlapping keys accumulate across ranks
+        kv.add(list(range(n_keys)), [rank + 1] * n_keys)
+    mv.process_barrier()
+    with mv.worker(0):
+        got = kv.get([0, n_keys // 2, n_keys - 1])
+        want = sum(range(1, world + 1))
+        assert [int(x) for x in got] == [want] * 3, (got, want)
+        assert kv._server_table.capacity > cap0, (
+            f"never grew past {cap0}")
+    mv.process_barrier()
+
+
 def run_crash(mv, np, rank: int, world: int) -> None:
     """Failure detection: rank 1 dies abruptly mid-run; the leader's next
     collective must fail LOUDLY within the Gloo deadline instead of
@@ -185,7 +207,13 @@ def run_crash(mv, np, rank: int, world: int) -> None:
     # the deadline instead of wedging until the harness kill
     import threading
 
-    deadline = time.monotonic() + 120
+    from multiverso_tpu import config as mv_config
+
+    # the watchdog must OUTLAST the system's own loud-failure bound
+    # (multihost_timeout governs every control-plane raise): expiring
+    # first would misreport a legitimately loud-but-slow error as a hang
+    loud_bound = float(mv_config.get_flag("multihost_timeout")) + 30.0
+    deadline = time.monotonic() + loud_bound + 60.0
     while time.monotonic() < deadline:
         outcome = {}
 
@@ -200,7 +228,7 @@ def run_crash(mv, np, rank: int, world: int) -> None:
 
         t = threading.Thread(target=attempt, daemon=True)
         t.start()
-        t.join(timeout=60)
+        t.join(timeout=loud_bound)
         if t.is_alive():
             print("LEADER_DID_NOT_DETECT_FAILURE (collective hung)",
                   flush=True)

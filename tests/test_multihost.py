@@ -72,5 +72,11 @@ def test_multihost_follower_crash_detected_loudly():
     leader prints LEADER_DETECTED_FAILURE and exits 0; the dead rank
     exits 42 by design (expressed via the shared spawner's ``expect``)."""
     spawn_lockstep_world(
-        _CHILD, "crash", devices_per_proc=2,
+        _CHILD, "crash", devices_per_proc=2, timeout=480,
         expect={0: (0, "LEADER_DETECTED_FAILURE"), 1: (42, None)})
+
+
+def test_multihost_device_kv_with_growth():
+    """DeviceKV across processes: hash add/get collectives and the
+    growth rebuild (device_put + replay) all run in lockstep."""
+    spawn_lockstep_world(_CHILD, "kv")
