@@ -168,17 +168,20 @@ def test_ps_trainer_all_modes_learn(mv_env, mode, objective, lr, epochs):
     assert trainer.count_table.get(0) == trainer.words_trained
 
 
-def test_ps_trainer_grouped_pipelined_learns(mv_env):
+@pytest.mark.parametrize("neg_sharing", [1, 8])
+def test_ps_trainer_grouped_pipelined_learns(mv_env, neg_sharing):
     """train(group=N) — the benched amortization recipe — must converge
     like ungrouped feeding: the kernel chunks internally at batch_pairs
     granularity, so only lr-decay granularity coarsens. Word accounting
-    must also stay exact under grouping."""
+    must also stay exact under grouping. neg_sharing=8 is the benched
+    shared-negatives recipe riding the same fused-transaction path."""
     vocab = 30
     rng = np.random.default_rng(4)
     corpus = _synthetic_corpus(rng, vocab, n=4000)
     d = _toy_dictionary(corpus, vocab)
     config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=4,
-                            lr=0.3, batch_pairs=512, sample=0.0)
+                            lr=0.3, batch_pairs=512, sample=0.0,
+                            neg_sharing=neg_sharing)
     trainer = PSTrainer(config, d)
     blocks = [corpus[i:i + 500] for i in range(0, len(corpus), 500)]
     trainer.train(blocks, epochs=10, group=4)
