@@ -36,6 +36,7 @@ def main() -> int:
                  # the collective programs)
                  remote_workers=1 if scenario == "remote" else 0,
                  multihost_endpoint=f"127.0.0.1:{ctl_port}",
+                 ssp_staleness=1 if scenario == "ssp" else -1,
                  sync=scenario in ("bsp", "bsp2"))
     mv.init(**flags)
     assert jax.device_count() > jax.local_device_count(), \
@@ -57,6 +58,8 @@ def main() -> int:
         run_crash(mv, np, rank, world)
     elif scenario == "kv":
         run_kv(mv, np, rank, world)
+    elif scenario == "ssp":
+        run_ssp(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -162,6 +165,28 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
         total = trainer.count_table.get(0)
     expected = sum(len(corpus[r::world]) for r in range(world))
     assert total == expected, (total, expected)
+    mv.process_barrier()
+
+
+def run_ssp(mv, np, rank: int, world: int) -> None:
+    """SSP across processes: with staleness=1, every worker's round-i Get
+    must reflect at least round i-1 of EVERY worker's Adds (gating runs
+    on the leader; followers' gets forward and wait like any other
+    gated mode)."""
+    from multiverso_tpu.config import get_flag
+
+    rows, cols, rounds = 16, 4, 5
+    s = int(get_flag("ssp_staleness"))  # main() set it; don't drift
+    assert s >= 0, "ssp scenario requires ssp_staleness"
+    mat = mv.create_table("matrix", num_row=rows, num_col=cols)
+    with mv.worker(0):
+        for i in range(1, rounds + 1):
+            mat.add(np.full((rows, cols), 1.0, np.float32))
+            got = mat.get()
+            lo = i + max(i - s, 0) * (world - 1)
+            hi = rounds * world
+            assert lo <= got[0, 0] <= hi, (rank, i, got[0, 0], lo, hi)
+        mat.finish_train()
     mv.process_barrier()
 
 

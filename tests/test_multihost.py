@@ -80,3 +80,9 @@ def test_multihost_device_kv_with_growth():
     """DeviceKV across processes: hash add/get collectives and the
     growth rebuild (device_put + replay) all run in lockstep."""
     spawn_lockstep_world(_CHILD, "kv")
+
+
+def test_multihost_ssp_staleness_contract():
+    """SSP bounded staleness across two processes: the leader's clocks
+    gate forwarded gets exactly like in-process ones."""
+    spawn_lockstep_world(_CHILD, "ssp")
