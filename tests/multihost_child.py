@@ -60,6 +60,8 @@ def main() -> int:
         run_kv(mv, np, rank, world)
     elif scenario == "ssp":
         run_ssp(mv, np, rank, world)
+    elif scenario == "asgd":
+        run_asgd(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -165,6 +167,40 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
         total = trainer.count_table.get(0)
     expected = sum(len(corpus[r::world]) for r in range(world))
     assert total == expected, (total, expected)
+    mv.process_barrier()
+
+
+def run_asgd(mv, np, rank: int, world: int) -> None:
+    """The ResNet-ASGD workflow shape across processes: each rank's
+    PytreeWorkerSync pushes model deltas into ONE ArrayTable sharded over
+    both processes' devices and pulls the merged model back (device IO
+    auto-falls back to the host path under multihost). Both ranks' SGD
+    work must land in the merged tree."""
+    import jax.numpy as jnp
+
+    from multiverso_tpu.ext import PytreeParamManager
+
+    params = {"w": jnp.zeros((4, 3)), "b": jnp.zeros((3,))}
+    pm = PytreeParamManager(params)  # collective table creation
+    view = pm.worker_view(device=True)  # multihost: host path, same API
+    # every view must capture its zero baseline BEFORE any rank pushes:
+    # a late view would absorb the peer's deltas into its baseline and
+    # push short (confirmed flaky under injected scheduling skew)
+    mv.process_barrier()
+    with mv.worker(0):
+        for step in range(3):
+            new = {"w": params["w"] + (rank + 1.0),
+                   "b": params["b"] + 0.5}
+            params = view.sync(new)
+    mv.process_barrier()
+    with mv.worker(0):
+        merged = view.sync(params)  # no-op delta: pull the global state
+    # every rank contributed 3 steps of +(rank+1) on w and +0.5 on b;
+    # syncs interleave, but the FINAL merged sums are exact
+    want_w = 3.0 * sum(range(1, world + 1))
+    want_b = 0.5 * 3 * world
+    np.testing.assert_allclose(np.asarray(merged["w"]), want_w, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(merged["b"]), want_b, rtol=1e-5)
     mv.process_barrier()
 
 

@@ -86,3 +86,10 @@ def test_multihost_ssp_staleness_contract():
     """SSP bounded staleness across two processes: the leader's clocks
     gate forwarded gets exactly like in-process ones."""
     spawn_lockstep_world(_CHILD, "ssp")
+
+
+def test_multihost_pytree_asgd_sync():
+    """The published-benchmark workflow (pytree ASGD sync through one
+    shared table) across two processes: both ranks' deltas land in the
+    merged model exactly."""
+    spawn_lockstep_world(_CHILD, "asgd")
