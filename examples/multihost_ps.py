@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Multi-host parameter server: one table sharded across TWO JAX
+processes' devices (the reference's add-MPI-ranks scaling story —
+src/zoo.cpp:73-145 — on the TPU substrate; see docs/multihost.md).
+
+Run:  python examples/multihost_ps.py
+      (self-launches two local JAX processes, each with 4 virtual CPU
+      devices, forming one 8-device global mesh; on real multi-host TPU
+      replace the self-launch with your per-host process launcher and
+      real `jax.distributed` coordinates)
+
+Each process hosts one worker; both train word2vec shards against ONE
+globally-sharded embedding-table pair through the lockstep dispatcher.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker_main(rank: int, world: int, coord: str, ctl: str) -> None:
+    """One JAX process of the world (run with argv: rank world coord ctl)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{coord}", world, rank)
+
+    import numpy as np
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.models.vocab import Dictionary
+    from multiverso_tpu.models.word2vec import PSTrainer, Word2VecConfig
+
+    mv.init(local_workers=1, multihost_endpoint=f"127.0.0.1:{ctl}")
+    print(f"[rank {rank}] mesh spans {jax.device_count()} devices "
+          f"({jax.local_device_count()} local)", flush=True)
+
+    vocab = 500
+    rng = np.random.default_rng(0)  # same corpus plan everywhere
+    corpus = rng.integers(0, vocab, size=20000).astype(np.int32)
+    d = Dictionary()
+    d.words = [f"w{i}" for i in range(vocab)]
+    d.word2id = {w: i for i, w in enumerate(d.words)}
+    d.counts = np.maximum(np.bincount(corpus, minlength=vocab), 1)
+
+    config = Word2VecConfig(vocab_size=vocab, dim=32, window=3, negatives=4,
+                            batch_pairs=1024, sample=0.0)
+    trainer = PSTrainer(config, d)  # collective: same tables, same order
+    shard = corpus[rank::world]     # this process's corpus shard
+    blocks = [shard[i:i + 2000] for i in range(0, len(shard), 2000)]
+    with mv.worker(0):
+        trainer.train(blocks, epochs=2, group=2)
+    mv.process_barrier()
+    with mv.worker(0):
+        emb = trainer.embeddings()
+        total = trainer.count_table.get(0)
+    print(f"[rank {rank}] trained; shared word-count table saw {total} "
+          f"words across ALL ranks; embeddings {emb.shape}", flush=True)
+    assert total == len(corpus) * 2  # both ranks' epochs landed
+    mv.shutdown()
+    print(f"MULTIHOST_EXAMPLE_OK rank={rank}", flush=True)
+
+
+def main() -> None:
+    """Local self-launch so the example runs with one command. This
+    launcher is deliberately visible (on real multi-host TPU, YOUR
+    per-host launcher plays this role); CI drives the hardened shared
+    harness instead (multiverso_tpu.runtime.multihost
+    .spawn_lockstep_world, used by tests/test_multihost.py)."""
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    coord, ctl = free_port(), free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                          str(rank), "2", str(coord), str(ctl)], env=env)
+        for rank in range(2)
+    ]
+    rcs = []
+    try:
+        # inner wait SHORTER than any CI harness timeout: a hung rank is
+        # diagnosed here (and its sibling killed below) rather than both
+        # being orphaned by an outer kill
+        rcs = [p.wait(timeout=540) for p in procs]
+    finally:
+        for rank, p in enumerate(procs):
+            if p.poll() is None:
+                print(f"killing hung worker rank {rank}", flush=True)
+                p.kill()
+    if any(rcs) or len(rcs) != len(procs):
+        raise SystemExit(f"worker processes failed: rcs={rcs}")
+    print("multihost example finished: one table pair, two hosts' devices")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 5:
+        worker_main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
+                    sys.argv[4])
+    else:
+        main()

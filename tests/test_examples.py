@@ -45,6 +45,17 @@ def test_lda_topics_example_runs_and_recovers_topics():
     assert purity > 0.8, f"LDA example purity too low: {purity}"
 
 
+def test_multihost_ps_example_runs():
+    """The multi-host example self-launches a 2-process world and trains
+    PS word2vec shards against one globally-sharded table pair. The
+    outer timeout exceeds the example's inner 540s wait so a hang is
+    diagnosed (and cleaned up) by the example itself, not an outer kill
+    that would orphan the grandchild workers."""
+    out = _run_example("multihost_ps.py", timeout=700)
+    assert "MULTIHOST_EXAMPLE_OK rank=0" in out
+    assert "MULTIHOST_EXAMPLE_OK rank=1" in out
+
+
 def test_asgd_param_manager_example_runs_and_learns():
     """Multi-thread ASGD through PytreeParamManager: the script must run
     and fit the planted linear model."""
