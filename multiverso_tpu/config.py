@@ -175,6 +175,10 @@ define_string("multihost_endpoint", "",
               "lockstep control plane; same value on every process")
 define_double("multihost_timeout", 120.0,
               "multihost control-plane connect/barrier timeout (seconds)")
+define_string("multihost_token", "",
+              "shared secret authenticating multihost control-plane "
+              "handshakes (HMAC-SHA256 over the hello frames); empty gives "
+              "integrity-only framing — see docs/multihost.md trust model")
 define_string("mesh_shape", "", "device mesh shape, e.g. '2x4'; empty = auto 1-D")
 define_bool("profile_annotations", False,
             "wrap dashboard monitor sections in jax.profiler.TraceAnnotation "

@@ -34,7 +34,10 @@ mode (``supports_device_io`` is False on the table proxies).
 
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_lib
 import io
+import json
 import pickle
 import socket
 import struct
@@ -44,10 +47,100 @@ from typing import Any, Dict, List, Optional, Tuple
 from multiverso_tpu import config, log
 from multiverso_tpu.runtime.message import Message, MsgType
 
-# flags: multihost_endpoint / multihost_timeout (defined in config.py so
-# they exist before this module is first imported)
+# flags: multihost_endpoint / multihost_timeout / multihost_token (defined
+# in config.py so they exist before this module is first imported)
 
 _LEN = struct.Struct("<q")
+
+# -- handshake frame (NON-pickle: struct + json, nothing code-executing) ----
+#
+# Trust model (docs/multihost.md): post-handshake control frames are pickle
+# and assume a private, firewalled interconnect — but the HANDSHAKE never
+# unpickles. Both directions exchange a fixed struct header + json body +
+# HMAC-SHA256 tag keyed on the `multihost_token` flag, so (a) a scanner or
+# stray client hitting the leader port is dropped before any pickle.loads,
+# (b) a follower dialing a wrong/stale endpoint fatals instead of replaying
+# garbage, and (c) divergent consistency flags are a loud bring-up error,
+# not a silent desync (the reference centralized this in its Controller
+# register protocol, src/controller.cpp:46-72).
+_HELLO_MAGIC = b"MVMH"
+_HELLO_VERSION = 2
+_HELLO_HDR = struct.Struct("<4sHII")  # magic, version, rank, json_len
+_HELLO_MAX_JSON = 1 << 16
+
+# flags every process of one lockstep world must agree on: they shape the
+# server semantics, the worker-id grid, and the collective programs
+_UNIFORM_FLAGS = ("sync", "ssp_staleness", "deterministic", "local_workers",
+                  "remote_workers", "ma", "backup_worker_ratio",
+                  "updater_type", "mesh_shape", "mesh_axes")
+
+
+def _hello_key() -> bytes:
+    token = str(config.get_flag("multihost_token"))
+    return hashlib.sha256(b"mv-multihost-v2:" + token.encode()).digest()
+
+
+def _uniform_flags() -> Dict[str, Any]:
+    return {name: config.get_flag(name) for name in _UNIFORM_FLAGS}
+
+
+def _hello_frame(rank: int, world: int) -> bytes:
+    body = json.dumps({"world": world, "flags": _uniform_flags()},
+                      sort_keys=True).encode()
+    head = _HELLO_HDR.pack(_HELLO_MAGIC, _HELLO_VERSION, rank, len(body))
+    mac = hmac_lib.new(_hello_key(), head + body, hashlib.sha256).digest()
+    return head + body + mac
+
+
+def _read_hello(sock: socket.socket) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """Read + authenticate one hello frame; None on any malformed input
+    (never raises on garbage, never executes it)."""
+    head = _read_exact(sock, _HELLO_HDR.size)
+    if head is None:
+        return None
+    try:
+        magic, version, rank, json_len = _HELLO_HDR.unpack(head)
+    except struct.error:
+        return None
+    if magic != _HELLO_MAGIC or version != _HELLO_VERSION:
+        return None
+    if not 0 < json_len <= _HELLO_MAX_JSON:
+        return None
+    rest = _read_exact(sock, json_len + 32)
+    if rest is None:
+        return None
+    body, mac = rest[:json_len], rest[json_len:]
+    want = hmac_lib.new(_hello_key(), head + body, hashlib.sha256).digest()
+    if not hmac_lib.compare_digest(mac, want):
+        return None
+    try:
+        info = json.loads(body)
+    except ValueError:
+        return None
+    if not isinstance(info, dict):
+        return None
+    return rank, info
+
+
+def _check_uniform_flags(peer_name: str, info: Dict[str, Any],
+                         world: int) -> None:
+    """Fatal (naming the flag) when a peer's consistency-relevant flags
+    differ from ours — divergent server semantics would desync silently."""
+    if info.get("world") != world:
+        log.fatal("multihost: %s runs a world of %s, this process expects "
+                  "%d — every process must pass the same topology",
+                  peer_name, info.get("world"), world)
+    theirs = info.get("flags")
+    if not isinstance(theirs, dict):
+        log.fatal("multihost: %s hello carries no flag digest", peer_name)
+    mine = _uniform_flags()
+    diff = [k for k in _UNIFORM_FLAGS if theirs.get(k) != mine[k]]
+    if diff:
+        detail = ", ".join(f"-{k}={theirs.get(k)!r} vs local {mine[k]!r}"
+                           for k in diff)
+        log.fatal("multihost: flag mismatch with %s — every process of a "
+                  "lockstep world must run identical consistency flags: %s",
+                  peer_name, detail)
 
 
 def _send_obj(sock: socket.socket, lock: threading.Lock, obj: Any) -> None:
@@ -171,9 +264,11 @@ class LockstepTable:
                 and isinstance(request[0], str) and request[0] == "transact"):
             log.fatal("device transactions are in-process only; multihost "
                       "tables take the staged host path")
-        self._runtime.broadcast_exec("add", self.table_id, origin, msg_id,
-                                     request)
-        return self._inner.process_add(request)
+        seq = self._runtime.broadcast_exec("add", self.table_id, origin,
+                                           msg_id, request)
+        return self._runtime.run_recorded(seq, "add",
+                                          lambda: self._inner.process_add(
+                                              request))
 
     def process_get(self, request: Any) -> Any:
         origin, msg_id, request = self._split(request)
@@ -189,9 +284,10 @@ class LockstepTable:
         thread: broadcast, then read; followers replay the identical
         collective into a discarded sink."""
         def run():
-            self._runtime.broadcast_exec("store", self.table_id, -1, 0,
-                                         None)
-            self._inner.store(stream)
+            seq = self._runtime.broadcast_exec("store", self.table_id, -1,
+                                               0, None)
+            self._runtime.run_recorded(seq, "store",
+                                       lambda: self._inner.store(stream))
 
         self._runtime.run_on_dispatcher(run)
 
@@ -203,9 +299,11 @@ class LockstepTable:
         payload = stream.read(-1)
 
         def run():
-            self._runtime.broadcast_exec("load", self.table_id, -1, 0,
-                                         payload)
-            self._inner.load(io.BytesIO(payload))
+            seq = self._runtime.broadcast_exec("load", self.table_id, -1,
+                                               0, payload)
+            self._runtime.run_recorded(seq, "load",
+                                       lambda: self._inner.load(
+                                           io.BytesIO(payload)))
 
         self._runtime.run_on_dispatcher(run)
 
@@ -267,8 +365,8 @@ class FollowerServer:
              request))
 
     # replay executor ------------------------------------------------------
-    def execute(self, op: str, table_id: int, origin: int, msg_id: int,
-                request: Any) -> None:
+    def execute(self, seq: int, op: str, table_id: int, origin: int,
+                msg_id: int, request: Any) -> None:
         mine = origin == self._runtime.rank
         try:
             table = self._tables[table_id]
@@ -287,8 +385,21 @@ class FollowerServer:
             else:
                 log.fatal("multihost replay: unknown op %r", op)
         except Exception as exc:
-            log.error("multihost replay %s on table %d failed: %r", op,
-                      table_id, exc)
+            if op != "get":
+                # a mutating replay failure is either a bad request every
+                # rank rejects identically (benign) or true divergence
+                # (the leader applied it). Only the leader knows which:
+                # report and let it adjudicate — it absolves a shared
+                # failure, or sends a targeted poison for divergence
+                # (round-4 advisor #2, refined: unconditional poison here
+                # let one malformed request kill every follower)
+                log.error("multihost replay %s on table %d failed (%r); "
+                          "reporting to the leader for adjudication", op,
+                          table_id, exc)
+                self._runtime.report_mut_failure(seq, f"{op}: {exc!r}")
+            else:
+                log.error("multihost replay %s on table %d failed: %r",
+                          op, table_id, exc)
             if mine:
                 self._runtime.fail_pending(msg_id, exc)
             return
@@ -321,45 +432,73 @@ class MultihostRuntime:
         self._follower: Optional[FollowerServer] = None
         self._leader_sock: Optional[socket.socket] = None
         self._leader_lock = threading.Lock()
+        # poison: set when this rank can no longer uphold the lockstep
+        # invariant (leader died, a mutating replay failed) — every later
+        # control-plane interaction fails LOUDLY instead of diverging
+        self._poisoned: Optional[str] = None
+        # leader-side outcomes of broadcast MUTATING ops, for adjudicating
+        # follower divergence reports (see run_recorded/_adjudicate)
+        self._outcomes: Dict[int, bool] = {}
+        self._outcome_floor = 0  # lowest seq still retained after pruning
+        self._outcome_cv = threading.Condition()
+        # cross-process host allreduce (mv.aggregate's global leg)
+        self._agg_seq = 0
+        self._agg_cv = threading.Condition()
+        self._agg_contrib: Dict[int, Tuple[int, List[Any]]] = {}
+        self._agg_event = threading.Event()
+        self._agg_payload: Optional[Tuple[int, List[Any]]] = None
 
     # -- bring-up ----------------------------------------------------------
     def connect(self) -> None:
+        import time
+
         host, port = self._endpoint.rsplit(":", 1)
+        # ONE monotonic deadline governs the whole bring-up: rejected
+        # handshakes (scanners, drip-feeders) consume the same budget as
+        # everything else instead of restarting the clock per accept
+        deadline = time.monotonic() + self._timeout
         if self.rank == 0:
             listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             listener.bind((host, int(port)))
             listener.listen(self.world)
-            listener.settimeout(self._timeout)
             while len(self._conns) < self.world - 1:
-                try:
-                    conn, _addr = listener.accept()
-                except TimeoutError:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     missing = sorted(set(range(1, self.world))
                                      - set(self._conns))
                     log.fatal("multihost: follower rank(s) %s never "
-                              "connected to %s within %.0fs", missing,
-                              self._endpoint, self._timeout)
+                              "completed the handshake with %s within "
+                              "%.0fs", missing, self._endpoint,
+                              self._timeout)
+                listener.settimeout(remaining)
+                try:
+                    conn, _addr = listener.accept()
+                except TimeoutError:
+                    continue  # deadline check at loop top fatals
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # bound the hello read too: an accepted connection that
-                # never speaks (scanner, half-dead follower) must not
-                # wedge bring-up past the configured timeout
-                conn.settimeout(self._timeout)
+                # never speaks must not wedge bring-up past the deadline
+                conn.settimeout(max(0.1, deadline - time.monotonic()))
                 try:
-                    hello = _recv_obj(conn)
-                except (OSError, pickle.UnpicklingError):
+                    hello = _read_hello(conn)
+                except OSError:
                     hello = None
-                if not (isinstance(hello, tuple) and len(hello) == 2
-                        and hello[0] == "hello"):
-                    log.error("multihost: dropping connection with bad "
-                              "handshake %r", hello)
+                if hello is None:
+                    log.error("multihost: dropping connection with bad or "
+                              "unauthenticated handshake (wrong "
+                              "multihost_token?)")
                     conn.close()
                     continue
-                peer = int(hello[1])
+                peer, info = hello
                 if not 1 <= peer < self.world or peer in self._conns:
                     log.fatal("multihost: follower handshake claims rank "
                               "%d (world %d, already connected: %s)",
                               peer, self.world, sorted(self._conns))
+                _check_uniform_flags(f"follower rank {peer}", info,
+                                     self.world)
+                # ack: authenticates the leader back and confirms admission
+                conn.sendall(_hello_frame(0, self.world))
                 conn.settimeout(None)
                 self._conns[peer] = conn
                 self._send_locks[peer] = threading.Lock()
@@ -372,8 +511,6 @@ class MultihostRuntime:
                 t.start()
                 self._threads.append(t)
         else:
-            import time
-            deadline = time.monotonic() + self._timeout
             sock = None
             while True:
                 try:
@@ -390,9 +527,27 @@ class MultihostRuntime:
                                   self._timeout)
                     time.sleep(0.1)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(max(1.0, deadline - time.monotonic()))
+            sock.sendall(_hello_frame(self.rank, self.world))
+            try:
+                ack = _read_hello(sock)
+            except OSError:
+                ack = None
+            if ack is None:
+                log.fatal("multihost: leader at %s did not return an "
+                          "authenticated ack — wrong endpoint, wrong "
+                          "multihost_token, or a flag mismatch the leader "
+                          "rejected (see its log)", self._endpoint)
+            _check_uniform_flags("the leader", ack[1], self.world)
             sock.settimeout(None)
             self._leader_sock = sock
-            _send_obj(sock, self._leader_lock, ("hello", self.rank))
+            # the reader thread exists from bring-up on (not only once a
+            # FollowerServer attaches): MA-mode worlds have no PS but
+            # still barrier and aggregate over this socket
+            t = threading.Thread(target=self._replay_loop,
+                                 name="mv-multihost-replay", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def attach_leader(self, server: Any) -> None:
         self._server = server
@@ -401,11 +556,10 @@ class MultihostRuntime:
         return LockstepTable(server_table, self)
 
     def start_follower(self, follower: FollowerServer) -> None:
+        # the reader thread already runs (spawned at connect); replay
+        # descriptors only start flowing once tables are registered, which
+        # is barrier-gated after this attach
         self._follower = follower
-        t = threading.Thread(target=self._replay_loop,
-                             name="mv-multihost-replay", daemon=True)
-        t.start()
-        self._threads.append(t)
 
     # -- leader side -------------------------------------------------------
     def run_on_dispatcher(self, fn: Any) -> Any:
@@ -415,7 +569,7 @@ class MultihostRuntime:
         return self._server.run_serialized(fn, timeout=self._timeout)
 
     def broadcast_exec(self, op: str, table_id: int, origin: int,
-                       msg_id: int, request: Any) -> None:
+                       msg_id: int, request: Any) -> int:
         """Emit one lockstep descriptor to every follower. Must run on
         the leader's dispatcher thread — that single thread's execution
         order IS the collective program order every process must share;
@@ -451,6 +605,73 @@ class MultihostRuntime:
                 log.error("multihost: lost follower %d mid-broadcast (%r);"
                           " dropping it from the control plane", peer, exc)
                 self._conns.pop(peer, None)
+        return self._seq
+
+    def run_recorded(self, seq: int, op: str, fn: Any) -> Any:
+        """Execute a broadcast MUTATING op on the leader and record its
+        outcome so follower divergence reports (``mut_failed``) can be
+        adjudicated: a failure the leader shares is a bad request every
+        rank skipped identically (absolve); a failure only the follower
+        hit means its replica diverged (targeted poison)."""
+        try:
+            result = fn()
+        except BaseException as exc:
+            self._record_outcome(seq, ok=False)
+            raise exc
+        self._record_outcome(seq, ok=True)
+        return result
+
+    def _record_outcome(self, seq: int, ok: bool) -> None:
+        with self._outcome_cv:
+            self._outcomes[seq] = ok
+            # Retention must exceed the deepest possible replay lag: the
+            # broadcast sendall blocks once a follower's socket buffer
+            # fills (natural backpressure), bounding in-flight
+            # descriptors to a few thousand — 64k retained outcomes is
+            # far beyond that, and an int->bool entry is tiny
+            if len(self._outcomes) > 65536:
+                for s in sorted(self._outcomes)[:32768]:
+                    del self._outcomes[s]
+                self._outcome_floor = min(self._outcomes)
+            self._outcome_cv.notify_all()
+
+    def _adjudicate(self, peer: int, seq: int, err: str) -> None:
+        """Leader response to a follower's mutating-replay failure. Runs
+        on that peer's recv thread (blocking it pauses only that peer)."""
+        with self._outcome_cv:
+            if seq < self._outcome_floor:
+                # pruned: the follower lagged beyond every plausible
+                # backpressure bound and the evidence is gone — poison
+                # honestly (cannot prove the replica did NOT diverge)
+                self._send_to(peer, ("poison",
+                                     f"replay of op seq {seq} failed "
+                                     f"({err}) and the leader no longer "
+                                     "retains its outcome — cannot rule "
+                                     "out divergence"))
+                return
+            known = self._outcome_cv.wait_for(
+                lambda: seq in self._outcomes, timeout=self._timeout)
+            leader_ok = self._outcomes.get(seq, True)
+        if not known:
+            # the leader never finished executing seq — it is likely stuck
+            # in the collective the follower failed to join; the cluster
+            # cannot make progress either way
+            self._send_to(peer, ("poison",
+                                 f"replay of op seq {seq} failed ({err}) "
+                                 "and the leader's own execution never "
+                                 "completed — cluster wedged"))
+        elif leader_ok:
+            log.error("multihost: follower %d DIVERGED on seq %d (%s) — "
+                      "the leader applied it; poisoning that rank", peer,
+                      seq, err)
+            self._send_to(peer, ("poison",
+                                 f"replay of mutating op seq {seq} failed "
+                                 f"({err}) but the leader applied it — "
+                                 "this rank's replica diverged"))
+        else:
+            log.info("multihost: rank %d and the leader both rejected "
+                     "seq %d (%s) — bad request, every replica skipped "
+                     "it identically", peer, seq, err)
 
     def _leader_recv_loop(self, peer: int, conn: socket.socket) -> None:
         while True:
@@ -477,6 +698,13 @@ class MultihostRuntime:
                 with self._barrier_cv:
                     self._barrier_arrivals += 1
                     self._barrier_cv.notify_all()
+            elif kind == "agg":
+                _, src, seq, leaves = obj
+                with self._agg_cv:
+                    self._agg_contrib[src] = (seq, leaves)
+                    self._agg_cv.notify_all()
+            elif kind == "mut_failed":
+                self._adjudicate(peer, obj[1], obj[2])
             elif kind == "bye":
                 return
             else:
@@ -495,10 +723,61 @@ class MultihostRuntime:
             log.error("multihost: send to %d failed: %r", peer, exc)
 
     # -- follower side -----------------------------------------------------
+    @property
+    def poisoned(self) -> Optional[str]:
+        return self._poisoned
+
+    def poison(self, reason: str) -> None:
+        """Mark this rank as unable to uphold the lockstep invariant
+        (leader died, a mutating replay diverged): fail every outstanding
+        completion now and every later interaction loudly — a poisoned
+        rank must never serve another value."""
+        if self._poisoned is not None:
+            return
+        self._poisoned = reason
+        log.error("multihost POISONED: %s", reason)
+        with self._pending_lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        err = RuntimeError(f"multihost rank poisoned: {reason}")
+        for completion in pending:
+            try:
+                completion.fail(err)
+            except Exception:  # a dead waiter must not mask the rest
+                pass
+        # wake anything blocked on the control plane; their post-wake
+        # poison check turns the wake into a loud fatal
+        self._agg_event.set()
+        self._barrier_release.set()
+
+    def _check_poison(self) -> None:
+        if self._poisoned is not None:
+            log.fatal("multihost rank poisoned: %s", self._poisoned)
+
+    def report_mut_failure(self, seq: int, err: str) -> None:
+        """Tell the leader this rank failed to replay mutating op ``seq``.
+        Replay CONTINUES while the leader adjudicates: if the leader
+        shared the failure (bad request) nothing happens; if the leader
+        applied the op, a targeted poison arrives within one round trip —
+        a bounded window traded for a deadlock-free protocol (the leader
+        may still be blocked inside the very collective we failed to
+        join, so waiting here could deadlock the reader thread)."""
+        try:
+            _send_obj(self._leader_sock, self._leader_lock,
+                      ("mut_failed", seq, err))
+        except OSError as exc:
+            self.poison(f"cannot report divergence to the leader: {exc!r}")
+
     def send_to_leader(self, obj: Any) -> None:
-        _send_obj(self._leader_sock, self._leader_lock, obj)
+        self._check_poison()
+        try:
+            _send_obj(self._leader_sock, self._leader_lock, obj)
+        except OSError as exc:
+            self.poison(f"cannot reach the leader (rank 0): {exc!r}")
+            self._check_poison()
 
     def register_pending(self, msg_id: int, completion: Any) -> None:
+        self._check_poison()
         with self._pending_lock:
             self._pending[msg_id] = completion
 
@@ -517,33 +796,131 @@ class MultihostRuntime:
 
     def _replay_loop(self) -> None:
         expect_seq = 0
-        while True:
+        while self._poisoned is None:
             obj = _recv_obj(self._leader_sock)
             if obj is None:
                 if not self._stopping.is_set():
-                    log.error("multihost: lost leader connection")
+                    # leader death is unrecoverable for a lockstep rank:
+                    # poison so every in-flight and future request fails
+                    # loudly instead of hanging (the reference worlds hung
+                    # silently on a dead root — SURVEY §5)
+                    self.poison("lost the leader (rank 0) connection — "
+                                "the lockstep stream is gone; this rank "
+                                "cannot continue")
                 return
             kind = obj[0]
             if kind == "exec":
                 _, seq, op, table_id, origin, msg_id, request = obj
                 expect_seq += 1
+                # poison (not log.fatal): a FatalError here would only
+                # kill this daemon thread, leaving the rank unpoisoned
+                # and every later op hanging — the exact silent failure
+                # the poison mechanism exists to prevent
                 if seq != expect_seq:
-                    log.fatal("multihost replay out of order: seq %d, "
-                              "expected %d — collective stream corrupt",
-                              seq, expect_seq)
-                self._follower.execute(op, table_id, origin, msg_id,
+                    self.poison(f"replay out of order: seq {seq}, "
+                                f"expected {expect_seq} — collective "
+                                "stream corrupt")
+                    return
+                if self._follower is None:
+                    self.poison("exec descriptor arrived on a rank with "
+                                "no follower server (MA-mode worlds have "
+                                "no PS tables)")
+                    return
+                self._follower.execute(seq, op, table_id, origin, msg_id,
                                        request)
             elif kind == "ack":
                 self.complete_pending(obj[1], obj[2])
             elif kind == "fail":
                 self.fail_pending(obj[1], RuntimeError(obj[2]))
+            elif kind == "agg_result":
+                self._agg_payload = (obj[1], obj[2])
+                self._agg_event.set()
             elif kind == "barrier_release":
                 self._barrier_release.set()
+            elif kind == "poison":
+                # the leader adjudicated a divergence report against us
+                self.poison(obj[1])
+                return
             elif kind == "stop":
                 self._stopping.set()
                 return
             else:
                 log.error("multihost: unknown descriptor %r", kind)
+
+    # -- cross-process allreduce (mv.aggregate's global leg) ---------------
+    def allreduce_host(self, leaves: List[Any]) -> List[Any]:
+        """Elementwise-sum a list of numpy leaves across every process:
+        followers ship their local sums to the leader, the leader reduces
+        and broadcasts the global result — the cross-process half of
+        ``MV_Aggregate`` (reference: ``MPI_Allreduce`` in
+        ``include/multiverso/net/mpi_net.h:147-151``; contract shape:
+        ``Test/test_allreduce.cpp:13-16``). COLLECTIVE: every process must
+        call it the same number of times in the same order (enforced by a
+        sequence check). One concurrent aggregate per process (Zoo's slot-0
+        worker is the single caller)."""
+        import numpy as np
+
+        self._check_poison()
+        self._agg_seq += 1
+        seq = self._agg_seq
+        if self.rank == 0:
+            with self._agg_cv:
+                if not self._agg_cv.wait_for(
+                        lambda: len(self._agg_contrib) >= self.world - 1,
+                        timeout=self._timeout):
+                    log.fatal("multihost aggregate timed out: %d/%d "
+                              "follower contributions after %.0fs — a "
+                              "rank is not calling mv.aggregate",
+                              len(self._agg_contrib), self.world - 1,
+                              self._timeout)
+                contribs = dict(self._agg_contrib)
+                self._agg_contrib.clear()
+            total = [np.array(x, copy=True) for x in leaves]
+            for src in sorted(contribs):
+                peer_seq, peer_leaves = contribs[src]
+                if peer_seq != seq:
+                    log.fatal("multihost aggregate desynchronized: rank %d "
+                              "is at call #%d, the leader at #%d — "
+                              "aggregate is collective and must run in the "
+                              "same order on every process", src, peer_seq,
+                              seq)
+                if len(peer_leaves) != len(total):
+                    log.fatal("multihost aggregate: rank %d deposited %d "
+                              "leaves, the leader %d", src,
+                              len(peer_leaves), len(total))
+                for i, leaf in enumerate(peer_leaves):
+                    total[i] += np.asarray(leaf)
+            # pickle ONCE, send the same framed bytes to every peer (the
+            # payload is a model's leaves in MA mode — O(world x bytes)
+            # re-serialization would stall every local worker on the
+            # aggregate barrier)
+            payload = pickle.dumps(("agg_result", seq, total),
+                                   protocol=pickle.HIGHEST_PROTOCOL)
+            framed = _LEN.pack(len(payload)) + payload
+            for peer in sorted(self._conns):
+                sock = self._conns.get(peer)
+                if sock is None:
+                    continue
+                try:
+                    with self._send_locks[peer]:
+                        sock.sendall(framed)
+                except OSError as exc:
+                    log.error("multihost: agg_result to %d failed: %r",
+                              peer, exc)
+            return total
+        self._agg_event.clear()
+        self.send_to_leader(("agg", self.rank, seq, leaves))
+        if not self._agg_event.wait(self._timeout):
+            log.fatal("multihost aggregate timed out after %.0fs waiting "
+                      "for the global sum (leader stuck or a rank missing "
+                      "its aggregate call)", self._timeout)
+        self._check_poison()  # the wake may have been a poison, not a result
+        got_seq, total = self._agg_payload
+        if got_seq != seq:
+            log.fatal("multihost aggregate: result for call #%d arrived "
+                      "while waiting for #%d — collective order violated",
+                      got_seq, seq)
+        return total
 
     # -- barrier -----------------------------------------------------------
     def barrier(self) -> None:
@@ -566,6 +943,7 @@ class MultihostRuntime:
             self.send_to_leader(("barrier_enter", self.rank))
             if not self._barrier_release.wait(self._timeout):
                 log.fatal("multihost barrier timed out waiting for release")
+            self._check_poison()  # a poison wake is loud, not a release
 
     # -- teardown ----------------------------------------------------------
     def shutdown(self) -> None:
@@ -580,14 +958,17 @@ class MultihostRuntime:
                     pass
             self._conns.clear()
         else:
-            try:
-                self.send_to_leader(("bye",))
-            except OSError:
-                pass
+            if self._poisoned is None:
+                try:
+                    self.send_to_leader(("bye",))
+                except (OSError, log.FatalError):
+                    pass  # a dying leader must not block OUR teardown
             # let the replay thread consume the leader's "stop" so no
-            # lockstep descriptor is dropped mid-collective
+            # lockstep descriptor is dropped mid-collective (a poisoned
+            # rank's reader thread has already exited)
+            join_timeout = self._timeout if self._poisoned is None else 5.0
             for t in self._threads:
-                t.join(timeout=self._timeout)
+                t.join(timeout=join_timeout)
             if self._leader_sock is not None:
                 try:
                     self._leader_sock.close()
@@ -609,7 +990,9 @@ def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
     rank's combined output; raises RuntimeError on any failure or missing
     OK marker. ``expect`` overrides the (returncode, required-marker)
     expectation per rank — ``(42, None)`` accepts a deliberately-crashed
-    rank (failure-injection scenarios)."""
+    rank (failure-injection scenarios); a LIST of such pairs accepts any
+    one of them (races between equally-loud failure paths), with
+    ``None`` in the returncode slot matching any exit code."""
     import os
     import subprocess
     import sys
@@ -648,11 +1031,14 @@ def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
             if p.poll() is None:
                 p.kill()
     for rank, (p, out) in enumerate(zip(procs, outs)):
-        want_rc, want_marker = (expect or {}).get(
-            rank, (0, f"MULTIHOST_CHILD_OK rank={rank}"))
-        if p.returncode != want_rc or (want_marker is not None
-                                       and want_marker not in out):
+        want = (expect or {}).get(rank,
+                                  (0, f"MULTIHOST_CHILD_OK rank={rank}"))
+        alts = want if isinstance(want, list) else [want]
+        ok = any((rc is None or p.returncode == rc)
+                 and (marker is None or marker in out)
+                 for rc, marker in alts)
+        if not ok:
             raise RuntimeError(f"lockstep world rank {rank} failed "
-                               f"(rc={p.returncode}, want {want_rc} with "
-                               f"{want_marker!r}):\n{out}")
+                               f"(rc={p.returncode}, want one of "
+                               f"{alts!r}):\n{out}")
     return outs

@@ -163,7 +163,11 @@ class Zoo:
     def stop(self, finalize_net: bool = True) -> None:
         if not self._started:
             return
-        self.process_barrier()
+        if not (self.multihost is not None
+                and self.multihost.poisoned is not None):
+            # a poisoned rank can never complete another rendezvous —
+            # teardown must still run (close sockets, free tables)
+            self.process_barrier()
         if self.remote_server is not None:
             self.remote_server.stop()
             self.remote_server = None
@@ -302,8 +306,12 @@ class Zoo:
     # -- aggregate (model averaging) ----------------------------------------
     def aggregate(self, data: Any) -> Any:
         """In-place-sum semantics of ``MV_Aggregate``: returns the elementwise
-        sum of `data` across every local worker context. Off-mesh processes
-        aggregate via the raw-net ring allreduce
+        sum of `data` across every local worker context — and, under a
+        multi-process (multihost) mesh, across EVERY process's workers:
+        the local sum rides the lockstep control plane to the leader,
+        which reduces and broadcasts the global total (the reference's
+        ``MPI_Allreduce`` contract, ``Test/test_allreduce.cpp:13-16``).
+        Off-mesh processes aggregate via the raw-net ring allreduce
         (:class:`multiverso_tpu.runtime.net.AllreduceEngine`).
 
         DEVICE path: pass a ``jax.Array`` (or list of them — a model's
@@ -361,6 +369,12 @@ class Zoo:
                     log.fatal("aggregate: workers mixed host and device "
                               "values in one round")
                 self._agg_result = reduce_fn(values)
+                if self.multihost is not None:
+                    # the local sum is one process's contribution; the
+                    # MV_Aggregate contract is ALL ranks' sum on every
+                    # rank (reference: MPI_Allreduce,
+                    # include/multiverso/net/mpi_net.h:147-151)
+                    self._agg_result = self._global_sum(self._agg_result)
             except BaseException:
                 # release peers (they see BrokenBarrierError) instead of
                 # wedging them on a barrier slot 0 will never reach
@@ -378,6 +392,36 @@ class Zoo:
             # resident in HBM until the next aggregate round
             self._agg_result = None
         return copy(result)
+
+    def _global_sum(self, result: Any) -> Any:
+        """Cross-process leg of aggregate under the multihost mesh: ship
+        this process's local sum through the control-plane allreduce and
+        return the all-ranks total in the caller's shape. Device values
+        hop through host numpy (the control plane carries host bytes
+        only) and return re-placed on their original local shardings;
+        values sharded over NON-addressable devices are rejected — an
+        XLA collective issued off the lockstep stream would desync the
+        mesh (use host arrays for globally-sharded state)."""
+        import jax
+
+        if _is_device_value(result):
+            leaves = (list(result) if isinstance(result, (list, tuple))
+                      else [result])
+            for leaf in leaves:
+                if not leaf.is_fully_addressable:
+                    log.fatal(
+                        "aggregate: device value is sharded over "
+                        "non-addressable devices — a cross-process device "
+                        "reduction cannot run off the lockstep stream; "
+                        "pass process-local arrays or host numpy instead")
+            total = self.multihost.allreduce_host(
+                [np.asarray(leaf) for leaf in leaves])
+            out = [jax.device_put(t, leaf.sharding)
+                   for t, leaf in zip(total, leaves)]
+            return out if isinstance(result, (list, tuple)) else out[0]
+        if isinstance(result, list):  # host leaf-list path
+            return self.multihost.allreduce_host(result)
+        return self.multihost.allreduce_host([np.asarray(result)])[0]
 
     def _device_sum(self, values):
         """ONE jitted tree-sum in HBM (arrays or matching lists of

@@ -30,14 +30,18 @@ def main() -> int:
     import numpy as np
     import multiverso_tpu as mv
 
-    flags = dict(local_workers=2 if scenario == "bsp2" else 1,
+    flags = dict(local_workers=2 if scenario in ("bsp2", "ma") else 1,
                  # remote slot expectations are part of num_workers and
                  # must MATCH across processes (table worker dims shape
                  # the collective programs)
                  remote_workers=1 if scenario == "remote" else 0,
                  multihost_endpoint=f"127.0.0.1:{ctl_port}",
                  ssp_staleness=1 if scenario == "ssp" else -1,
-                 sync=scenario in ("bsp", "bsp2"))
+                 ma=scenario == "ma",
+                 # flagmismatch: rank 1 deliberately diverges on `sync` —
+                 # bring-up must fatal NAMING the flag, not desync later
+                 sync=(scenario in ("bsp", "bsp2")
+                       or (scenario == "flagmismatch" and rank == 1)))
     mv.init(**flags)
     assert jax.device_count() > jax.local_device_count(), \
         "mesh does not span processes"
@@ -62,6 +66,16 @@ def main() -> int:
         run_ssp(mv, np, rank, world)
     elif scenario == "asgd":
         run_asgd(mv, np, rank, world)
+    elif scenario == "ma":
+        run_ma(mv, np, rank, world)
+    elif scenario == "leadercrash":
+        run_leadercrash(mv, np, rank, world)
+    elif scenario == "flagmismatch":
+        run_flagmismatch(mv, np, rank, world)
+    elif scenario == "badreq":
+        run_badreq(mv, np, rank, world)
+    elif scenario == "ctrlperf":
+        run_ctrlperf(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -244,6 +258,172 @@ def run_kv(mv, np, rank: int, world: int) -> None:
         assert kv._server_table.capacity > cap0, (
             f"never grew past {cap0}")
     mv.process_barrier()
+
+
+def run_ma(mv, np, rank: int, world: int) -> None:
+    """Model-averaging mode (``-ma=true``: no PS at all) across processes:
+    ``mv.aggregate`` must hand EVERY worker on EVERY rank the all-workers
+    sum — the reference's ``MV_Aggregate``/MPI_Allreduce contract, whose
+    canonical test shape is aggregate(1) == MV_Size
+    (``Test/test_allreduce.cpp:13-16``). Exercises all three value shapes
+    (scalar-array, host leaf list, device array) over the 2-worker x
+    world grid."""
+    import threading
+
+    import jax.numpy as jnp
+
+    workers = 2 * world
+    results: dict = {}
+    errors: list = []
+
+    def work(slot: int) -> None:
+        try:
+            with mv.worker(slot):
+                wid = rank * 2 + slot
+                # the reference contract shape: aggregate(ones) == #workers
+                r1 = mv.aggregate(np.ones(8, np.float32))
+                # host leaf-list (a model's leaves)
+                r2 = mv.aggregate([
+                    np.full(3, float(wid + 1), np.float32),
+                    np.ones((2, 2), np.float32)])
+                # device path: local jax.Arrays hop through the control
+                # plane and come back on device
+                r3 = mv.aggregate(jnp.full((4,), float(wid + 1)))
+                results[slot] = (r1, r2, r3)
+        except Exception as exc:  # surfaced by the assert below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=work, args=(s,)) for s in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=240)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads), "aggregate worker hung"
+    wid_sum = float(sum(range(1, workers + 1)))
+    for slot in range(2):
+        r1, r2, r3 = results[slot]
+        np.testing.assert_allclose(r1, np.full(8, float(workers)))
+        np.testing.assert_allclose(r2[0], np.full(3, wid_sum))
+        np.testing.assert_allclose(r2[1], np.full((2, 2), float(workers)))
+        import jax
+        assert isinstance(r3, jax.Array), type(r3)  # device in, device out
+        np.testing.assert_allclose(np.asarray(r3), np.full(4, wid_sum))
+    mv.process_barrier()
+
+
+def run_leadercrash(mv, np, rank: int, world: int) -> None:
+    """Leader (rank 0) dies abruptly mid-run: every follower must fail
+    LOUDLY within the control-plane bound — the replay loop poisons the
+    rank on leader-socket EOF, so the next table op raises instead of
+    hanging (round-4 verdict: the one crash mode without a loud-failure
+    test)."""
+    import os as _os
+    import threading
+    import time
+
+    from multiverso_tpu import config as mv_config
+
+    mat = mv.create_table("matrix", num_row=16, num_col=4)
+    with mv.worker(0):
+        mat.add(np.ones((16, 4), np.float32))
+        mat.get()
+    mv.process_barrier()
+    if rank == 0:
+        _os._exit(42)  # simulated leader-host failure: no goodbye
+    loud_bound = float(mv_config.get_flag("multihost_timeout")) + 30.0
+    deadline = time.monotonic() + loud_bound + 60.0
+    while time.monotonic() < deadline:
+        outcome: dict = {}
+
+        def attempt() -> None:
+            try:
+                with mv.worker(0):
+                    mat.add(np.ones((16, 4), np.float32))
+                    mat.get()
+                outcome["ok"] = True
+            except BaseException as exc:  # noqa: BLE001 — loud = pass
+                outcome["exc"] = exc
+
+        t = threading.Thread(target=attempt, daemon=True)
+        t.start()
+        t.join(timeout=loud_bound)
+        if t.is_alive():
+            print("FOLLOWER_DID_NOT_DETECT_LEADER_DEATH (op hung)",
+                  flush=True)
+            _os._exit(1)
+        if "exc" in outcome:
+            mv.shutdown()  # teardown on a poisoned rank must not raise
+            print("FOLLOWER_DETECTED_LEADER_DEATH "
+                  f"{type(outcome['exc']).__name__}", flush=True)
+            _os._exit(0)
+        time.sleep(0.5)  # leader still draining; retry
+    print("FOLLOWER_DID_NOT_DETECT_LEADER_DEATH (no error before deadline)",
+          flush=True)
+    _os._exit(1)
+
+
+def run_badreq(mv, np, rank: int, world: int) -> None:
+    """A malformed request must fail ONLY its caller, not the world: the
+    leader and every follower reject it identically, the leader absolves
+    the followers' divergence reports, and traffic continues (refinement
+    of the round-4 advisor's poison rule — unconditional poisoning let
+    one bad request kill every follower rank)."""
+    mat = mv.create_table("matrix", num_row=16, num_col=4)
+    with mv.worker(0):
+        mat.add(np.ones((16, 4), np.float32))
+    mv.process_barrier()
+    if rank == world - 1:  # a FOLLOWER sends the malformed add
+        with mv.worker(0):
+            try:
+                mat.add(np.ones((2, 4), np.float32))  # wrong whole-table
+                raise AssertionError("malformed add did not raise")
+            except AssertionError:
+                raise
+            except Exception:
+                pass  # the caller gets the failure; the world survives
+    mv.process_barrier()
+    with mv.worker(0):
+        mat.add(np.ones((16, 4), np.float32))
+    mv.process_barrier()
+    with mv.worker(0):
+        got = mat.get()
+    np.testing.assert_allclose(
+        got, np.full((16, 4), 2.0 * world, np.float32),
+        err_msg="table corrupted or a rank was wrongly poisoned")
+    mv.process_barrier()
+
+
+def run_ctrlperf(mv, np, rank: int, world: int) -> None:
+    """Bound + record the lockstep control plane's per-op cost: a sync
+    row add from EVERY rank (followers pay the full forward -> leader
+    execute -> broadcast -> replay -> ack round trip). The 50ms median
+    bound is a loose anti-regression guard — measured medians are ~3ms
+    on a loaded CI host (recorded in bench.py's multihost_ctrl_op_us)."""
+    import time
+
+    mat = mv.create_table("matrix", num_row=64, num_col=8)
+    ones = np.ones((4, 8), np.float32)
+    ids = np.arange(4, dtype=np.int32)
+    with mv.worker(0):
+        mat.add(ones, row_ids=ids)  # warm
+        samples = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            mat.add(ones, row_ids=ids)
+            samples.append(time.perf_counter() - t0)
+    med = sorted(samples)[len(samples) // 2]
+    print(f"CTRL_OP_MEDIAN_US rank={rank} {med * 1e6:.1f}", flush=True)
+    assert med < 0.05, (
+        f"lockstep ctrl op median {med * 1e3:.2f}ms exceeds the 50ms bound")
+    mv.process_barrier()
+
+
+def run_flagmismatch(mv, np, rank: int, world: int) -> None:
+    # unreachable: main()'s mv.init must already have fataled on the
+    # divergent `sync` flag during the handshake
+    raise AssertionError(
+        "flag-mismatch world initialized despite divergent sync flag")
 
 
 def run_crash(mv, np, rank: int, world: int) -> None:
