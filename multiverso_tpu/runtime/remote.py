@@ -441,14 +441,25 @@ class _RemoteMatrixWorker(MatrixWorker):
 
     def _submit(self, msg_type, request):
         # quantize row-delta ADDs with per-row error feedback (whole-table
-        # adds use ids=None -> full-shape residual). Duplicate row ids in
-        # one batch share a residual read and last-write the update — an
-        # EF approximation; servers dedupe ids anyway
+        # adds use ids=None -> full-shape residual)
         if (self._ef is not None and msg_type == MsgType.Request_Add
                 and isinstance(request, tuple) and len(request) == 3
                 and isinstance(request[1], np.ndarray)
                 and request[1].dtype == np.float32):
             ids, values, option = request
+            if ids is not None:
+                # pre-aggregate duplicate ids so every touched row's
+                # residual is read and written exactly once — duplicates
+                # would otherwise share one residual read and last-write
+                # the update, permanently losing part of the feedback
+                id_arr = np.asarray(ids)
+                uniq, inverse = np.unique(id_arr, return_inverse=True)
+                if len(uniq) != len(id_arr):
+                    merged = np.zeros((len(uniq),) + values.shape[1:],
+                                      values.dtype)
+                    np.add.at(merged, inverse, values)
+                    ids = uniq.astype(id_arr.dtype, copy=False)
+                    values = merged
             request = (ids, self._ef.compress(values, ids), option)
         return super()._submit(msg_type, request)
 

@@ -104,6 +104,20 @@ def test_unknown_scheme_fatal():
         get_stream("nosuch://x/y", "r")
 
 
+def test_hdfs_scheme_routes_through_fsspec_fallback():
+    """A literal ``hdfs://`` URI (the reference's second scheme,
+    src/io/hdfs_stream.cpp) must DISPATCH to the fsspec fallback — the
+    deployment-gated driver — not die as an unsupported protocol; with
+    no cluster/libhdfs here the stream reports bad loudly at use time."""
+    from multiverso_tpu.io import FsspecStream
+
+    s = get_stream("hdfs://namenode:9000/tmp/x", "r")
+    assert isinstance(s, FsspecStream)
+    assert not s.good()  # gated on a real cluster, loud on use
+    with pytest.raises(log.FatalError):
+        s.read()
+
+
 def test_text_reader(tmp_path):
     path = str(tmp_path / "lines.txt")
     with open(path, "w") as fp:

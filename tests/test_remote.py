@@ -524,3 +524,33 @@ def test_quant_wire_compression_ratio_and_error_feedback_convergence():
     _, dec, _ = wire.decode(qblobs)
     assert dec.shape == delta.shape
     assert np.abs(dec - delta).max() < np.abs(delta).max()
+
+
+def test_quant_duplicate_ids_preaggregated_before_error_feedback():
+    """A quantized ADD batch with DUPLICATE row ids must apply exactly
+    the same update as the equivalent pre-aggregated batch: duplicates
+    are merged client-side before ErrorFeedback.compress so each row's
+    residual is read and written once (round-4 advisor: duplicates
+    previously shared one residual read and last-wrote the update,
+    permanently losing part of the feedback)."""
+    mv.set_flag("wire_quant_bits", 8)
+    try:
+        mv.init(remote_workers=1)
+        ta = mv.create_table("matrix", num_row=4, num_col=3)
+        tb = mv.create_table("matrix", num_row=4, num_col=3)
+        endpoint = mv.serve("127.0.0.1:0")
+        client = mv.remote_connect(endpoint)
+        ra, rb = client.table(ta.table_id), client.table(tb.table_id)
+        rng = np.random.default_rng(7)
+        vals = rng.normal(size=(5, 3)).astype(np.float32)
+        dup_ids = np.array([0, 2, 0, 1, 2], np.int32)
+        ra.add(vals, row_ids=dup_ids)
+        merged = np.zeros((3, 3), np.float32)
+        np.add.at(merged, dup_ids, vals)
+        rb.add(merged, row_ids=np.array([0, 1, 2], np.int32))
+        np.testing.assert_array_equal(np.asarray(ra.get()),
+                                      np.asarray(rb.get()))
+        client.close()
+    finally:
+        mv.shutdown()
+        mv.set_flag("wire_quant_bits", 0)
