@@ -42,6 +42,7 @@ static void (*MV_Barrier)(void);
 static int (*MV_NumWorkers)(void);
 static int (*MV_NumServers)(void);
 static int (*MV_WorkerId)(void);
+static int (*MV_ServerId)(void);
 static int (*MV_Rank)(void);
 static int (*MV_Size)(void);
 static void (*MV_SetFlag)(const char*, const char*);
@@ -52,6 +53,7 @@ static void (*MV_AddAsyncArrayTable)(TableHandler, float*, int);
 static void (*MV_NewMatrixTable)(int, int, TableHandler*);
 static void (*MV_GetMatrixTableAll)(TableHandler, float*, int);
 static void (*MV_AddMatrixTableAll)(TableHandler, float*, int);
+static void (*MV_AddAsyncMatrixTableAll)(TableHandler, float*, int);
 static void (*MV_GetMatrixTableByRows)(TableHandler, float*, int, int*, int);
 static void (*MV_AddMatrixTableByRows)(TableHandler, float*, int, int*, int);
 static void (*MV_AddAsyncMatrixTableByRows)(TableHandler, float*, int, int*,
@@ -137,6 +139,7 @@ int main(void) {
   MV_NumWorkers = must_sym(lib, "MV_NumWorkers");
   MV_NumServers = must_sym(lib, "MV_NumServers");
   MV_WorkerId = must_sym(lib, "MV_WorkerId");
+  MV_ServerId = must_sym(lib, "MV_ServerId");
   MV_Rank = must_sym(lib, "MV_Rank");
   MV_Size = must_sym(lib, "MV_Size");
   MV_SetFlag = must_sym(lib, "MV_SetFlag");
@@ -147,6 +150,7 @@ int main(void) {
   MV_NewMatrixTable = must_sym(lib, "MV_NewMatrixTable");
   MV_GetMatrixTableAll = must_sym(lib, "MV_GetMatrixTableAll");
   MV_AddMatrixTableAll = must_sym(lib, "MV_AddMatrixTableAll");
+  MV_AddAsyncMatrixTableAll = must_sym(lib, "MV_AddAsyncMatrixTableAll");
   MV_GetMatrixTableByRows = must_sym(lib, "MV_GetMatrixTableByRows");
   MV_AddMatrixTableByRows = must_sym(lib, "MV_AddMatrixTableByRows");
   MV_AddAsyncMatrixTableByRows = must_sym(lib, "MV_AddAsyncMatrixTableByRows");
@@ -157,6 +161,7 @@ int main(void) {
   CHECK(MV_NumWorkers() >= 1);
   CHECK(MV_NumServers() >= 1);
   CHECK(MV_WorkerId() >= 0);
+  CHECK(MV_ServerId() == 0); /* default role: this process is the server */
   CHECK(MV_Rank() == 0);
   CHECK(MV_Size() == 1);
 
@@ -233,6 +238,17 @@ int main(void) {
   free(ids);
   free(rdelta);
   free(rout);
+
+  /* async whole-matrix add (MatrixTableHandler:add default spelling) */
+  float* adelta = calloc(18, sizeof(float));
+  for (int i = 0; i < 18; ++i) adelta[i] = 0.25f;
+  MV_AddAsyncMatrixTableAll(mat, adelta, 18);
+  MV_Barrier(); /* drain the async tail before reading */
+  float* aout = calloc(18, sizeof(float));
+  MV_GetMatrixTableAll(mat, aout, 18);
+  CHECK(fabsf(aout[0] - 0.75f) < 1e-4f); /* 0.5 (sync all) + 0.25 */
+  free(adelta);
+  free(aout);
 
   MV_ShutDown();
   printf("lua ffi replay passed\n");
