@@ -30,6 +30,8 @@ from multiverso_tpu import log  # noqa: F401  (re-export)
 from multiverso_tpu.config import get_flag, parse_cmd_flags, set_flag  # noqa: F401
 from multiverso_tpu.dashboard import Dashboard, Timer, monitor  # noqa: F401
 from multiverso_tpu.runtime.node import Role  # noqa: F401
+from multiverso_tpu.runtime.programs import (  # noqa: F401
+    register_program, registered_programs)
 from multiverso_tpu.runtime.zoo import Zoo
 
 __version__ = "0.1.0"

@@ -887,10 +887,18 @@ class PSTrainer:
             self._fast_delta_fn = jax.jit(fast_delta, donate_argnums=(0, 1))
             self._fast_key = jax.random.PRNGKey(config.seed + 1)
             self._fast_key_queue: list = []  # pre-split batch, see below
-            self._txn_fn = None  # built lazily: needs in-process servers
+            self._txn_fn = None
+            self._txn_name: Optional[str] = None
             # cap on the per-block negative pool (draw volume otherwise
             # tracks the old per-pair path: ~len(block)*window*negatives)
             self.neg_pool = 16384
+            if self._can_transact():
+                # build + REGISTER eagerly: under a multihost mesh a
+                # replayed descriptor naming this program can arrive from
+                # leader-origin traffic before this rank's first submit —
+                # trainer construction is collective, so eager
+                # registration on every rank closes that window
+                self._build_txn_fn()
 
     # -- host-side batch shaping ---------------------------------------------
     def _block_pairs(self, block: np.ndarray):
@@ -940,9 +948,14 @@ class PSTrainer:
         if len(block) < 2:
             return None
         lr = self.config.lr if lr is None else lr
-        if (self._fast_sgns
-                and getattr(self.input_table, "supports_device_io", False)
-                and getattr(self.output_table, "supports_device_io", False)):
+        if self._fast_sgns and (
+                (getattr(self.input_table, "supports_device_io", False)
+                 and getattr(self.output_table, "supports_device_io",
+                             False))
+                # multihost: device IO proper is off, but the NAMED fused
+                # transaction rides the lockstep stream — the fast path's
+                # txn branch is exactly that
+                or self._can_transact()):
             return self._submit_block_fast(block, lr)
         in_tok, in_w, predict = self._block_pairs(block)
         if len(predict) == 0:
@@ -1074,17 +1087,22 @@ class PSTrainer:
                 "block_len": int(len(block))}
 
     def _can_transact(self) -> bool:
-        """Device transactions need in-process tables (the fused jit reads
-        the servers' device state directly) and the plain async server
+        """Fused transactions need in-process tables (the fused jit reads
+        the servers' device state) and an async-semantics server
         (BSP/deterministic keep per-table clocks a cross-table transaction
-        cannot honor — those fall back to the staged pull/push path)."""
+        cannot honor — those fall back to the staged pull/push path).
+        Under a multihost mesh the NAMED form rides the lockstep stream
+        (descriptor = program name + host args; every rank resolves its
+        own identical jit), so cross-process worlds qualify too."""
         if (getattr(self.input_table, "_server_table", None) is None
                 or getattr(self.output_table, "_server_table", None) is None):
             return False
         if not hasattr(self.input_table, "transact_device_async"):
             return False
         from multiverso_tpu.runtime.zoo import Zoo
-        return getattr(Zoo.instance().server, "plain_async", False)
+        server = Zoo.instance().server
+        return (getattr(server, "plain_async", False)
+                or getattr(server, "supports_named_transact", False))
 
     def _build_txn_fn(self) -> None:
         """The whole PS block as one fused jit over both tables' device
@@ -1126,6 +1144,13 @@ class PSTrainer:
 
         self._txn_fn = jax.jit(txn, donate_argnums=(0, 1),
                                static_argnums=(8, 9, 10, 11))
+        # name the program so the transaction can ride a multihost
+        # lockstep descriptor: table ids are collective, so every rank
+        # derives the same name for its identical locally-built jit
+        from multiverso_tpu.runtime.programs import register_program
+        self._txn_name = register_program(
+            f"mv.w2v.block_txn/{self.input_table.table_id}"
+            f"/{self.output_table.table_id}", self._txn_fn)
 
     def _submit_block_fast(self, block: np.ndarray, lr: float
                            ) -> Optional[Dict]:
@@ -1205,7 +1230,14 @@ class PSTrainer:
             # dispatch submission costs ~1-3 ms through the tunnel
             keys = jax.random.split(self._fast_key, 65)
             self._fast_key = keys[0]
-            self._fast_key_queue = list(keys[1:])
+            from multiverso_tpu.runtime.zoo import Zoo
+            if Zoo.instance().multihost is not None:
+                # multihost descriptors need HOST keys: one batched
+                # readback per 64 blocks here, not a blocking per-block
+                # device->host key fetch on the submit hot path
+                self._fast_key_queue = list(np.asarray(keys[1:]))
+            else:
+                self._fast_key_queue = list(keys[1:])
         sub = self._fast_key_queue.pop()
         scale = (-1.0 / lr) if self.use_adagrad else 1.0
 
@@ -1231,13 +1263,25 @@ class PSTrainer:
             opt = AddOption(
                 worker_id=self.input_table._channel.worker_id(),
                 learning_rate=lr)
-            worker, scalars = (
-                self.input_table._server_table._option_consts(opt))
-            packed = jnp.asarray(np.concatenate(
-                [ids_in_p, ids_out_p, blocks_c.reshape(-1), slot_alias]))
+            from multiverso_tpu.runtime.zoo import Zoo
+            packed_np = np.concatenate(
+                [ids_in_p, ids_out_p, blocks_c.reshape(-1), slot_alias])
+            if Zoo.instance().multihost is not None:
+                # multihost descriptor: HOST args only (the jit converts
+                # at trace/dispatch on every rank); same math as the
+                # device consts below
+                st = self.input_table._server_table
+                worker = int(max(opt.worker_id, 0)
+                             % max(1, st.num_workers))
+                scalars = np.asarray(opt.scalars(), np.float32)
+                packed, sub_arg = packed_np, np.asarray(sub)
+            else:
+                worker, scalars = (
+                    self.input_table._server_table._option_consts(opt))
+                packed, sub_arg = jnp.asarray(packed_np), sub
             h = self.input_table.transact_device_async(
-                self._txn_fn, [self.output_table],
-                args=(packed, sub, lr, scale, worker, scalars,
+                self._txn_name, [self.output_table],
+                args=(packed, sub_arg, lr, scale, worker, scalars,
                       b_in, b_out, blocks_c.shape[0], blocks_c.shape[1]))
             # the candidate gathers still happen (inside the fused jit) —
             # they just never leave HBM; keep the pull accounting so

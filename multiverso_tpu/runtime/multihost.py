@@ -262,8 +262,11 @@ class LockstepTable:
         origin, msg_id, request = self._split(request)
         if (isinstance(request, tuple) and request
                 and isinstance(request[0], str) and request[0] == "transact"):
-            log.fatal("device transactions are in-process only; multihost "
-                      "tables take the staged host path")
+            log.fatal("raw-closure device transactions are in-process "
+                      "only; use a NAMED transaction "
+                      "(mv.register_program + transact_device_async(name, "
+                      "...)) — the one device-transaction form that rides "
+                      "the lockstep stream — or the staged host path")
         seq = self._runtime.broadcast_exec("add", self.table_id, origin,
                                            msg_id, request)
         return self._runtime.run_recorded(seq, "add",
@@ -334,9 +337,18 @@ class FollowerServer:
 
     @property
     def plain_async(self) -> bool:
-        # device transactions are in-process-only regardless of the
-        # leader's server type
+        # raw-closure device IO stays in-process-only regardless of the
+        # leader's server type (payloads cannot cross the control plane)
         return False
+
+    @property
+    def supports_named_transact(self) -> bool:
+        """Named transactions DO cross processes: the descriptor carries
+        a program name + host args, every rank resolves and runs the
+        identical locally-built jit (runtime/programs.py). Admissible
+        exactly when the leader's server is plain async — recomputed from
+        the (handshake-enforced identical) flags."""
+        return not (self.gates_gets or self.defers_adds)
 
     def start(self) -> None:
         self._runtime.start_follower(self)
@@ -403,7 +415,13 @@ class FollowerServer:
             if mine:
                 self._runtime.fail_pending(msg_id, exc)
             return
-        if mine and op == "get":
+        named_txn = (op == "add" and isinstance(request, tuple) and request
+                     and isinstance(request[0], str)
+                     and request[0] == "transact_named")
+        if mine and (op == "get" or named_txn):
+            # the locally-materialized result (GET rows / a transaction's
+            # device reply) completes the origin's pending request — the
+            # payload rode the mesh, never TCP
             self._runtime.complete_pending(msg_id, result)
 
 
@@ -594,7 +612,9 @@ class MultihostRuntime:
         self._seq += 1
         framed = _LEN.pack(len(payload)) + payload
         for peer in sorted(self._conns):
-            sock = self._conns[peer]
+            sock = self._conns.get(peer)  # recv-crash handler pops
+            if sock is None:              # concurrently on its own thread
+                continue
             try:
                 with self._send_locks[peer]:
                     sock.sendall(framed)
@@ -674,6 +694,22 @@ class MultihostRuntime:
                      "it identically", peer, seq, err)
 
     def _leader_recv_loop(self, peer: int, conn: socket.socket) -> None:
+        try:
+            self._leader_recv_body(peer, conn)
+        except Exception:  # noqa: BLE001
+            # a dying recv thread must WEDGE nothing: log with traceback
+            # and close the socket so the follower sees EOF and poisons
+            # itself loudly (silent thread death stranded a whole world)
+            import traceback
+            log.error("multihost: recv loop for follower %d crashed:\n%s",
+                      peer, traceback.format_exc())
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._conns.pop(peer, None)
+
+    def _leader_recv_body(self, peer: int, conn: socket.socket) -> None:
         while True:
             obj = _recv_obj(conn)
             if obj is None:
@@ -687,9 +723,20 @@ class MultihostRuntime:
                 data: List[Any] = []
                 if msg_type.is_server_bound and msg_type in (
                         MsgType.Request_Add, MsgType.Request_Get):
+                    # named transactions complete like GETs: the origin
+                    # materializes the (device) reply at replay time —
+                    # the leader must NOT ack, its device result cannot
+                    # cross the control plane. (isinstance-str FIRST: a
+                    # plain add's request[0] is an id ARRAY, and
+                    # ndarray == str is an elementwise comparison whose
+                    # truth value raises — it killed this recv thread)
+                    named_txn = (isinstance(request, tuple) and request
+                                 and isinstance(request[0], str)
+                                 and request[0] == "transact_named")
                     completion = _ForwardCompletion(
                         self, peer, msg_id,
-                        is_add=msg_type == MsgType.Request_Add)
+                        is_add=(msg_type == MsgType.Request_Add
+                                and not named_txn))
                     data = [_Forwarded(peer, msg_id, request), completion]
                 self._server.send(Message(
                     src=src, dst=-1, type=msg_type, table_id=table_id,
@@ -795,6 +842,15 @@ class MultihostRuntime:
                             else RuntimeError(repr(exc)))
 
     def _replay_loop(self) -> None:
+        try:
+            self._replay_body()
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+            log.error("multihost: replay loop crashed:\n%s",
+                      traceback.format_exc())
+            self.poison(f"replay loop crashed: {exc!r}")
+
+    def _replay_body(self) -> None:
         expect_seq = 0
         while self._poisoned is None:
             obj = _recv_obj(self._leader_sock)
@@ -1013,6 +1069,12 @@ def spawn_lockstep_world(child_script: str, scenario: str, world: int = 2,
                         f"{devices_per_proc}")
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("_MV_DRYRUN_CHILD", None)
+    # children inherit our process group on purpose: a harness killed by
+    # an outer SIGKILL orphans them (nothing can prevent that from in
+    # here — a preexec PDEATHSIG hook was tried and deadlocks forked
+    # children of this thread-heavy parent), so outer drivers should
+    # SIGTERM/kill the process GROUP; the finally below covers every
+    # in-process failure path
     procs = [
         subprocess.Popen(
             [sys.executable, child_script, str(rank), str(world),

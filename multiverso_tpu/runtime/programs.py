@@ -1,0 +1,60 @@
+"""Named device-program registry: the multihost-compatible form of
+device transactions.
+
+Reference capability (not copied): the reference's multi-table block
+protocols shipped closures implicitly — every rank ran the same binary,
+so "which code applies this block" never crossed the wire
+(``src/communicator.cpp`` RequestParameter/AddDeltaParameter pairs).
+Lockstep descriptors, by contrast, must be host-serializable: a Python
+closure (and the device arrays it captures) cannot ride the control
+plane.
+
+The TPU-native answer: programs are registered BY NAME, collectively, on
+every process (the same create-before-traffic contract tables follow);
+a transaction descriptor then carries only the name plus host args
+(numpy ids/keys/scalars), and every rank resolves the name to its own
+locally-built jit — identical by construction, so all controllers issue
+the same fused collective program. See
+:meth:`multiverso_tpu.tables.matrix_table.MatrixWorker.transact_device_async`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict
+
+from multiverso_tpu import log
+
+_registry: Dict[str, Callable] = {}
+_lock = threading.Lock()
+
+
+def register_program(name: str, fn: Callable, overwrite: bool = True) -> str:
+    """Register a fused device program under ``name``. Under a multihost
+    mesh this must happen on EVERY process (same name, equivalent fn)
+    before any transaction references it — registration is process-local
+    by design, like jit caches. Returns the name for chaining."""
+    if not isinstance(name, str) or not name:
+        log.fatal("register_program: name must be a non-empty string")
+    with _lock:
+        if name in _registry and not overwrite:
+            log.fatal("register_program: %r already registered", name)
+        _registry[name] = fn
+    return name
+
+
+def resolve_program(name: str) -> Callable:
+    with _lock:
+        fn = _registry.get(name)
+    if fn is None:
+        log.fatal(
+            "unknown device program %r — register_program(name, fn) must "
+            "run on every process (collectively, before traffic) for "
+            "named transactions to replay; registered: %s", name,
+            sorted(_registry))
+    return fn
+
+
+def registered_programs() -> list:
+    with _lock:
+        return sorted(_registry)

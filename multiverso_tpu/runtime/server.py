@@ -83,6 +83,13 @@ class Server:
         cannot forget to flip it)."""
         return not (self.gates_gets or self.defers_adds)
 
+    @property
+    def supports_named_transact(self) -> bool:
+        """Named (registry-resolved) transactions are admissible exactly
+        when raw ones are; FollowerServer overrides — named transactions
+        are the ONE device-transaction form that crosses processes."""
+        return self.plain_async
+
     def __init__(self, num_workers: int) -> None:
         self.num_workers = num_workers
         self._tables: Dict[int, "object"] = {}  # table_id -> ServerTable

@@ -155,6 +155,15 @@ class WorkerTable:
 class ServerTable:
     """Device-resident table shard set + checkpoint hooks."""
 
+    def _unwrapped(self):
+        """This server table with any lockstep wrapper peeled off (a
+        named transaction's secondary tables are state holders, not
+        dispatch points — the PRIMARY table's descriptor already covers
+        the op; see MatrixServer._resolve_named). On any real table this
+        is the identity; the multihost LockstepTable forwards it to its
+        inner table via __getattr__."""
+        return self
+
     def __init__(self) -> None:
         self.table_id: int = -1
         self._replicate = None  # lazy replicate-jit for multihost host reads

@@ -201,6 +201,8 @@ class MatrixServer(ServerTable):
     def process_add(self, request):
         if isinstance(request[0], str) and request[0] == "transact":
             return self._process_transact(request)
+        if isinstance(request[0], str) and request[0] == "transact_named":
+            return self._process_transact(self._resolve_named(request))
         row_ids, values, option = request
         option = option or AddOption()
         # administrative access (worker id -1) charges slot 0, not slot n-1
@@ -271,6 +273,20 @@ class MatrixServer(ServerTable):
             with self._std_lock:
                 live = row_ids[row_ids < self.num_row]
                 self._up_to_date[:, live] = False
+
+    def _resolve_named(self, request):
+        """Rehydrate a named transaction descriptor into the live form:
+        resolve the program name to this rank's locally-built jit and the
+        table ids to this rank's server tables — the host-serializable
+        indirection that lets device transactions ride the multihost
+        lockstep stream (see runtime/programs.py)."""
+        from multiverso_tpu.runtime.programs import resolve_program
+        from multiverso_tpu.runtime.zoo import Zoo
+
+        _, name, other_ids, args, touched = request
+        server = Zoo.instance().server
+        others = [server.table(tid)._unwrapped() for tid in other_ids]
+        return ("transact", resolve_program(name), others, args, touched)
 
     def _process_transact(self, request):
         """Device transaction: ONE dispatcher op that reads several tables'
@@ -572,20 +588,32 @@ class MatrixWorker(WorkerTable):
         ``fn`` should be jitted with ``donate_argnums=(0, 1)`` — the
         tables' buffers are updated in place.
 
-        In-process only, plain async server only: round-gated/deferred
-        servers (BSP/deterministic) account per-table clocks that a
-        cross-table transaction cannot honor — callers check the server's
+        ``fn`` may be a NAME registered via
+        :func:`multiverso_tpu.runtime.programs.register_program` — the
+        only form that works across a multihost mesh (a closure cannot
+        ride a lockstep descriptor; a name resolves on every rank to the
+        locally-built identical jit, and ``args`` must then be host data:
+        numpy/scalars). Raw-callable form is in-process only.
+
+        Plain async server only: round-gated/deferred servers
+        (BSP/deterministic) account per-table clocks that a cross-table
+        transaction cannot honor — callers check the server's
         ``gates_gets``/``defers_adds`` and use the staged pull/push path
         there."""
         if self.is_sparse:
             log.fatal("device IO is not available on is_sparse tables")
-        self._require_device_io()
+        named = isinstance(fn, str)
+        multihost = Zoo.instance().multihost is not None
+        if not named:
+            self._require_device_io()  # closures are in-process-only
         server = Zoo.instance().server
-        if not getattr(server, "plain_async", False):
+        if not (getattr(server, "plain_async", False)
+                or (named and getattr(server, "supports_named_transact",
+                                      False))):
             log.fatal("transact_device_async requires the plain async "
                       "server (BSP/deterministic servers keep per-table "
                       "clocks a cross-table transaction cannot honor)")
-        other_servers = []
+        other_ids = []
         for o in others:
             st = getattr(o, "_server_table", None)
             if st is None:
@@ -597,8 +625,23 @@ class MatrixWorker(WorkerTable):
                 # would silently skip staleness invalidation and serve
                 # other workers stale rows from their client caches
                 log.fatal("device IO is not available on is_sparse tables")
-            other_servers.append(st)
-        return super().add_async(("transact", fn, other_servers,
+            other_ids.append((o.table_id, st))
+        if named:
+            if multihost:
+                import jax
+                for a in args:
+                    if isinstance(a, jax.Array):
+                        log.fatal("named transaction args must be host "
+                                  "data under a multihost mesh (numpy/"
+                                  "scalars) — device arrays cannot ride "
+                                  "the lockstep control plane")
+            # the named request carries table IDS, not live objects:
+            # host-serializable, resolved rank-locally at execution
+            return super().add_async(
+                ("transact_named", fn, tuple(tid for tid, _ in other_ids),
+                 tuple(args), touched))
+        return super().add_async(("transact", fn,
+                                  [st for _, st in other_ids],
                                   tuple(args), touched))
 
     @property

@@ -76,6 +76,8 @@ def main() -> int:
         run_badreq(mv, np, rank, world)
     elif scenario == "ctrlperf":
         run_ctrlperf(mv, np, rank, world)
+    elif scenario == "namedtxn":
+        run_namedtxn(mv, np, rank, world)
     else:
         raise SystemExit(f"unknown scenario {scenario}")
     mv.shutdown()
@@ -167,10 +169,16 @@ def run_w2v(mv, np, rank: int, world: int) -> None:
     config = Word2VecConfig(vocab_size=vocab, dim=16, window=2, negatives=3,
                             batch_pairs=512, sample=0.0)
     trainer = PSTrainer(config, d)  # collective table creation
+    # async multihost worlds must engage the NAMED fused-transaction path
+    # (one lockstep descriptor per block, payload = program name + host
+    # ids; table bytes ride the mesh) — not the staged host fallback
+    assert trainer._can_transact(), "named-txn path not engaged"
     shard = corpus[rank::world]
     with mv.worker(0):
         for i in range(0, len(shard), 500):
-            loss = trainer.train_block(shard[i:i + 500])
+            pend = trainer.submit_block(shard[i:i + 500])
+            assert pend is None or "txn" in pend, sorted(pend)
+            loss = trainer.finish_block(pend)
             assert np.isfinite(loss), loss
     mv.process_barrier()
     with mv.worker(0):
@@ -361,6 +369,60 @@ def run_leadercrash(mv, np, rank: int, world: int) -> None:
     print("FOLLOWER_DID_NOT_DETECT_LEADER_DEATH (no error before deadline)",
           flush=True)
     _os._exit(1)
+
+
+def run_namedtxn(mv, np, rank: int, world: int) -> None:
+    """Named device transaction across processes, exactness-pinned: a
+    registered two-table fused program (scaled add into both tables +
+    a device reply) submitted from a FOLLOWER must update every rank's
+    replica exactly and hand the origin the device reply materialized
+    at replay (payload rides the mesh, never TCP)."""
+    import jax
+    import jax.numpy as jnp
+
+    rows, cols = 16, 8
+    a = mv.create_table("matrix", num_row=rows, num_col=cols)
+    b = mv.create_table("matrix", num_row=rows, num_col=cols)
+
+    def fused(datas, states, ids, scale):
+        # server state is 128-lane column-padded: touch (and sum) only
+        # the logical columns
+        da, db = datas
+        delta = jnp.zeros((ids.shape[0], da.shape[1]),
+                          da.dtype).at[:, :cols].set(scale)
+        da = da.at[ids].add(delta)
+        db = db.at[ids].add(2.0 * delta)
+        return [da, db], states, (da[ids, :cols] + db[ids, :cols]).sum()
+
+    mv.register_program("test.fused_pair", jax.jit(
+        fused, donate_argnums=(0, 1)))
+    ids = np.arange(4, dtype=np.int32)
+    if rank == world - 1:  # follower origin: the full lockstep round
+        with mv.worker(0):
+            h = a.transact_device_async("test.fused_pair", [b],
+                                        args=(ids, 2.5))
+            reply = a.wait(h)
+        assert isinstance(reply, jax.Array), type(reply)
+        # a rows: 2.5 each; b rows: 5.0 each -> sum = 4*8*7.5
+        np.testing.assert_allclose(float(reply), 4 * cols * 7.5)
+    mv.process_barrier()
+    with mv.worker(0):
+        got_a, got_b = a.get(), b.get()  # every rank's replica
+    expect_a = np.zeros((rows, cols), np.float32)
+    expect_a[:4] = 2.5
+    np.testing.assert_allclose(got_a, expect_a)
+    np.testing.assert_allclose(got_b, 2.0 * expect_a)
+    mv.process_barrier()
+    # raw closures must still be rejected loudly under multihost
+    with mv.worker(0):
+        try:
+            a.transact_device_async(lambda d, s: (d, s, None), [b])
+            raise AssertionError("raw closure transact did not fail")
+        except AssertionError:
+            raise
+        except Exception:
+            pass
+    mv.process_barrier()
 
 
 def run_badreq(mv, np, rank: int, world: int) -> None:
