@@ -115,7 +115,7 @@ def bench_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=40):
 
 
 def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4,
-                      group=16):
+                      group=64, batch_pairs=32768):
     """End-to-end parameter-server words/sec: the full product path —
     candidate-row pulls through the dispatcher, compact-space scan training,
     delta pushes through the updater (the reference's only benchmarked
@@ -155,8 +155,14 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4,
     # gather/scatter traffic measurably (+33% at group=16 measured);
     # PS-path convergence at this setting is covered by
     # tests/test_word2vec.py::test_ps_trainer_grouped_pipelined_learns[8]
+    # group=64 x batch_pairs=32768 (scan chunk 8192, matching the device
+    # path's step granularity): measured sweep at matched ~20 GB/s probes
+    # — group 16/32/64 at bp=8192: 2.05/2.45/2.62 M words/s; 64 at
+    # bp=32768: 2.69M (chunk 2048 -> 8192 closes the per-step overhead
+    # gap vs the device bench, which also steps 8192 tokens at a time)
     config = Word2VecConfig(vocab_size=vocab, dim=dim, window=5, negatives=5,
-                            batch_pairs=8192, sample=0.0, neg_sharing=8)
+                            batch_pairs=batch_pairs, sample=0.0,
+                            neg_sharing=8)
 
     p = counts.astype(np.float64) / counts.sum()
     cdf = np.cumsum(p)
