@@ -37,7 +37,7 @@ from multiverso_tpu import log
 from multiverso_tpu.models.vocab import Dictionary, HuffmanEncoder
 from multiverso_tpu.ops.sampling import unigram_negative_sampler
 from multiverso_tpu.parallel import mesh as mesh_lib
-from multiverso_tpu.utils import next_pow2 as _next_pow2
+from multiverso_tpu.utils import async_upload, next_pow2 as _next_pow2
 
 
 @dataclass(frozen=True)
@@ -683,7 +683,8 @@ class DeviceTrainer:
                            (np.arange(bp) < n).astype(np.float32))}
 
     def train_block(self, block: np.ndarray, lr: Optional[float] = None) -> float:
-        block = subsample_block(block, self.keep, self.rng)
+        if self.config.sample > 0:  # sample=0 keeps everything: skip the draw
+            block = subsample_block(block, self.keep, self.rng)
         lr = self.config.lr if lr is None else lr
         losses = []  # device values; sync ONCE at block end to keep steps pipelined
         if self.use_block_step:
@@ -695,7 +696,7 @@ class DeviceTrainer:
                         [chunk, np.full(t - len(chunk), -1, np.int32)])
                 self.key, sub = jax.random.split(self.key)
                 self.params, loss = self.block_step_fn(
-                    self.params, sub, jnp.asarray(chunk), lr)
+                    self.params, sub, async_upload(chunk), lr)
                 losses.append(loss)
         else:
             for batch in self._batches(block):
@@ -944,7 +945,8 @@ class PSTrainer:
         one thread prefetched the next block's rows while others trained
         (distributed_wordembedding.cpp:202-223). Returns a pending record
         for ``finish_block``; None when the block degenerates."""
-        block = subsample_block(block, self.keep, self.rng)
+        if self.config.sample > 0:  # sample=0 keeps everything: skip the draw
+            block = subsample_block(block, self.keep, self.rng)
         if len(block) < 2:
             return None
         lr = self.config.lr if lr is None else lr
@@ -1278,7 +1280,7 @@ class PSTrainer:
             else:
                 worker, scalars = (
                     self.input_table._server_table._option_consts(opt))
-                packed, sub_arg = jnp.asarray(packed_np), sub
+                packed, sub_arg = async_upload(packed_np), sub
             h = self.input_table.transact_device_async(
                 self._txn_name, [self.output_table],
                 args=(packed, sub_arg, lr, scale, worker, scalars,
@@ -1292,8 +1294,8 @@ class PSTrainer:
                     "n_out": len(ids_out), "pairs": -1, "stats": None}
 
         delta_in, delta_out, stats = self._fast_delta_fn(
-            cached_in, cached_out, sub, jnp.asarray(blocks_c),
-            jnp.asarray(slot_alias), lr, scale)
+            cached_in, cached_out, sub, async_upload(blocks_c),
+            async_upload(slot_alias), lr, scale)
 
         sentinel_i = self.input_table.sentinel_row
         sentinel_o = self.output_table.sentinel_row

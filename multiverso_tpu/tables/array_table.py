@@ -25,6 +25,7 @@ from multiverso_tpu import log
 from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime.zoo import Zoo
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
+from multiverso_tpu.utils import async_upload
 from multiverso_tpu.updaters import AddOption, GetOption, Updater, get_updater
 
 
@@ -268,7 +269,7 @@ class ArrayServer(ServerTable):
         # jax.Array input never touches the host (the TPU-era ASGD path —
         # param sync is HBM-to-HBM)
         if not isinstance(delta, jax.Array):
-            delta = jnp.asarray(np.asarray(delta, dtype=self.dtype))
+            delta = async_upload(np.asarray(delta, dtype=self.dtype))
         delta = delta.reshape(-1).astype(self.dtype)
         if delta.size != self.size:
             log.fatal("ArrayTable.add: delta size %d != table size %d",

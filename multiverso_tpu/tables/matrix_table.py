@@ -39,7 +39,7 @@ from multiverso_tpu.runtime.zoo import Zoo
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
 from multiverso_tpu.tables.array_table import _make_whole_update
 from multiverso_tpu.updaters import AddOption, GetOption, SGDUpdater, Updater, get_updater
-from multiverso_tpu.utils import next_pow2 as _next_pow2
+from multiverso_tpu.utils import async_upload, next_pow2 as _next_pow2
 
 
 import functools
@@ -194,8 +194,8 @@ class MatrixServer(ServerTable):
         if values is not None:
             padded = np.zeros((bucket, self.padded_cols), dtype=values.dtype)
             padded[:n, : self.num_col] = values
-            vals_p = jnp.asarray(padded)
-        return jnp.asarray(ids_p), vals_p, n
+            vals_p = async_upload(padded)
+        return async_upload(ids_p), vals_p, n
 
     # -- server ops --------------------------------------------------------
     def process_add(self, request):
@@ -220,7 +220,8 @@ class MatrixServer(ServerTable):
             delta[: self.num_row, : self.num_col] = np.asarray(
                 values, dtype=self.dtype).reshape(self.num_row, self.num_col)
             self.data, self.states = self._whole_update(
-                self.data, self.states, jnp.asarray(delta), worker, scalars)
+                self.data, self.states, async_upload(delta), worker,
+                scalars)
             touched: Optional[np.ndarray] = None
         else:
             row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
@@ -259,7 +260,7 @@ class MatrixServer(ServerTable):
                       n, values.shape[0])
         from multiverso_tpu.ops.pallas_rows import ROW_GROUP
         bucket = max(_next_pow2(n), ROW_GROUP)
-        ids_p = jnp.asarray(np.concatenate(
+        ids_p = async_upload(np.concatenate(
             [row_ids, np.full(bucket - n, self.sentinel_row, np.int32)]))
         vals_p = _device_pad(values.astype(self.dtype), bucket,
                              self.padded_cols)
