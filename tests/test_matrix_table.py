@@ -190,3 +190,44 @@ def test_transact_refused_on_sparse_table(mv_env):
     with pytest.raises(mv.log.FatalError):
         table.transact_device_async(
             lambda datas, states: (datas, states, None), [])
+
+
+def test_named_transact_roundtrip_and_gating(mv_env):
+    """Named (registry-resolved) transactions in-process: registration +
+    execution match the raw-closure form exactly, and an unknown name
+    fails loudly. The multihost legs live in tests/test_multihost.py;
+    this pins the single-process semantics the replay relies on."""
+    import jax
+    import jax.numpy as jnp
+
+    a = mv.create_table("matrix", 8, 4, np.float32)
+    b = mv.create_table("matrix", 8, 4, np.float32)
+
+    def fused(datas, states, ids, scale):
+        da, db = datas
+        delta = jnp.zeros((ids.shape[0], da.shape[1]),
+                          da.dtype).at[:, :4].set(scale)
+        na, nb = da.at[ids].add(delta), db.at[ids].add(2.0 * delta)
+        return [na, nb], states, na[ids, :4].sum()
+    mv.register_program("test.inproc_pair", jax.jit(
+        fused, donate_argnums=(0, 1)))
+    ids = np.array([1, 3], np.int32)
+    h = a.transact_device_async("test.inproc_pair", [b], args=(ids, 1.5))
+    reply = a.wait(h)
+    np.testing.assert_allclose(float(reply), 2 * 4 * 1.5)
+    np.testing.assert_allclose(a.get()[ids], 1.5)
+    np.testing.assert_allclose(b.get()[ids], 3.0)
+    with pytest.raises(mv.log.FatalError):
+        a.wait(a.transact_device_async("test.no_such_program", [b],
+                                       args=(ids, 1.0)))
+
+
+def test_named_transact_refused_on_gated_server(sync_env):
+    """Round-gated (BSP) servers keep per-table clocks a cross-table
+    transaction cannot honor: the NAMED form must be refused exactly
+    like the raw-closure form."""
+    a = mv.create_table("matrix", 8, 4, np.float32)
+    b = mv.create_table("matrix", 8, 4, np.float32)
+    mv.register_program("test.gated_pair", lambda d, s: (d, s, None))
+    with pytest.raises(mv.log.FatalError):
+        a.transact_device_async("test.gated_pair", [b])
