@@ -6,13 +6,15 @@
 #   make check          native build + tests + multi-chip dryrun + bench
 #   make native         just the C++ layer (libmultiverso_tpu.so + C client)
 #   make test           just the suite (8-device virtual CPU mesh)
+#   make chaos          the fault-injection suite under a fixed seed
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+CHAOS_SEED ?= 7
 
-.PHONY: check native test dryrun bench clean
+.PHONY: check chaos native test dryrun bench clean
 
 check: native test dryrun bench
 
@@ -23,6 +25,10 @@ native:
 
 test: native
 	$(PYTHON) -m pytest tests/ -x -q
+
+chaos:
+	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
+		tests/test_fault.py -q -p no:cacheprovider -p no:randomly
 
 dryrun:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"

@@ -219,6 +219,17 @@ def remote_connect(endpoint: str, timeout: float = 30.0):
     return RemoteClient(endpoint, timeout=timeout)
 
 
+def stop_serving() -> None:
+    """Stop the remote table server while keeping the runtime up. A later
+    ``serve()`` binds fresh — the server-restart recovery path: restart,
+    ``checkpoint.restore_tables(...)``, ``serve()`` on the old endpoint,
+    and reconnecting clients resume (see docs/fault_tolerance.md)."""
+    zoo = Zoo.instance()
+    if zoo.remote_server is not None:
+        zoo.remote_server.stop()
+        zoo.remote_server = None
+
+
 # -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------
 # External (off-mesh) hosts — the reference's CNTK/C# deployment shape
 # (include/multiverso/multiverso.h:60-65, ZMQ Bind/Connect mode) — drive the

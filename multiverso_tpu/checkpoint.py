@@ -131,6 +131,25 @@ def load_table(table, address: str) -> None:
         _run_serialized(lambda: server.load(stream))
 
 
+def restore_tables(tables: List, directory: str) -> int:
+    """Load the latest ``CheckpointDriver`` snapshot for each table found
+    under ``directory``; returns how many tables were restored. The
+    server-restart recovery hook (docs/fault_tolerance.md): a restarted
+    serving process re-creates its tables (same order, so table ids match
+    the snapshot's) and calls this BEFORE ``serve()``, so clients that
+    reconnect-and-resume read restored state rather than fresh zeros."""
+    fs = mv_io.fs_for(directory)
+    restored = 0
+    for table in tables:
+        server = getattr(table, "_server_table", table)
+        tid = getattr(server, "table_id", 0)
+        path = mv_io.join(directory, f"table_{tid}.mvckpt")
+        if fs.exists(path):
+            load_table(table, path)
+            restored += 1
+    return restored
+
+
 class CheckpointDriver:
     """Periodic snapshot driver over a set of tables.
 
@@ -187,15 +206,7 @@ class CheckpointDriver:
     def restore(self) -> bool:
         """Load the latest snapshot; returns False when none exists."""
         with self._lock:
-            loaded = False
-            for table in self.tables:
-                server = getattr(table, "_server_table", table)
-                tid = getattr(server, "table_id", 0)
-                path = mv_io.join(self.directory, f"table_{tid}.mvckpt")
-                if self._fs.exists(path):
-                    load_table(table, path)
-                    loaded = True
-            return loaded
+            return restore_tables(self.tables, self.directory) > 0
 
     def close(self) -> None:
         self._stop.set()

@@ -190,3 +190,37 @@ define_string("mesh_axes", "server", "comma-separated mesh axis names")
 define_bool("deterministic", False,
             "async PS applies adds in (round, worker_id) order so the final "
             "table state is bitwise reproducible (DeterministicServer)")
+
+# Fault subsystem (multiverso_tpu/fault/): injection, retry/replay, liveness.
+define_string("fault_spec", "",
+              "fault-injection schedule applied to host transports "
+              "(fault/inject.py): ';'-separated rules "
+              "'action:key=val,key=val' with actions drop|delay|dup|reorder|"
+              "partition, predicates src/dst/type/table and limiters "
+              "first/after/every/prob (delay takes seconds=). Empty disables")
+define_int("fault_seed", 0,
+           "seed for probabilistic fault rules (prob=) so chaos runs replay")
+define_double("request_retry_seconds", 5.0,
+              "remote client retransmit timeout: a correlated request with "
+              "no reply after this long is re-sent (exponentially backed "
+              "off); the server's req-id dedup window keeps the replay "
+              "idempotent. 0 disables retransmission")
+define_double("reconnect_deadline_seconds", 20.0,
+              "total budget for a remote client's reconnect-and-resume "
+              "after a connection loss before pending requests fail; "
+              "0 restores the fail-fast posture (no reconnect)")
+define_double("retry_base_seconds", 0.05,
+              "reconnect backoff base: attempt k sleeps "
+              "~base*2^(k-1), jittered, capped by retry_cap_seconds")
+define_double("retry_cap_seconds", 2.0,
+              "upper bound on a single reconnect backoff sleep")
+define_double("heartbeat_seconds", 2.0,
+              "remote client lease-renewal period (Control_Heartbeat); "
+              "0 disables heartbeats (disable lease eviction too)")
+define_double("lease_seconds", 10.0,
+              "remote worker lease: the sync watchdog evicts a worker whose "
+              "last sign of life (heartbeat or any request) is older than "
+              "this, releasing BSP/SSP rounds it was holding; 0 disables")
+define_int("dedup_window", 4096,
+           "server-side request-id dedup window (entries) bounding the "
+           "idempotent-replay cache for retried remote requests")

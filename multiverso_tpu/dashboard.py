@@ -69,10 +69,40 @@ class Monitor:
                 f"elapse={self.elapse_ms:.3f}ms, average={self.average_ms:.3f}ms)")
 
 
+class Counter:
+    """Monotonic event counter — the fault subsystem's observability unit
+    (retries, reconnects, evictions, injected faults, dedup hits). Section
+    timers (Monitor) measure durations; Counters record discrete events
+    that have none."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def __repr__(self) -> str:
+        return f"Counter({self.name}: {self.value})"
+
+
 class Dashboard:
     """Global registry of monitors (reference: ``Dashboard::Watch/Display``)."""
 
     _monitors: Dict[str, Monitor] = {}
+    _counters: Dict[str, Counter] = {}
     _lock = threading.Lock()
     profile_annotations: bool = False
 
@@ -90,10 +120,26 @@ class Dashboard:
             return cls._monitors.get(name)
 
     @classmethod
+    def counter(cls, name: str) -> Counter:
+        with cls._lock:
+            ctr = cls._counters.get(name)
+            if ctr is None:
+                ctr = cls._counters[name] = Counter(name)
+            return ctr
+
+    @classmethod
+    def counter_value(cls, name: str) -> int:
+        """Current count; 0 when the counter was never touched."""
+        with cls._lock:
+            ctr = cls._counters.get(name)
+        return ctr.value if ctr is not None else 0
+
+    @classmethod
     def display(cls) -> str:
         with cls._lock:
             lines = ["--------------Dashboard--------------------"]
             lines.extend(repr(m) for m in cls._monitors.values())
+            lines.extend(repr(c) for c in cls._counters.values())
         text = "\n".join(lines)
         print(text, flush=True)
         return text
@@ -102,6 +148,7 @@ class Dashboard:
     def reset(cls) -> None:
         with cls._lock:
             cls._monitors.clear()
+            cls._counters.clear()
 
 
 @contextmanager
@@ -119,6 +166,11 @@ def monitor(name: str) -> Iterator[Monitor]:
         if ann is not None:
             ann.__exit__(None, None, None)
         mon.end()
+
+
+def count(name: str, n: int = 1) -> None:
+    """Bump a named event counter (``Dashboard.counter(name).add(n)``)."""
+    Dashboard.counter(name).add(n)
 
 
 class Timer:

@@ -1,0 +1,27 @@
+"""Fault subsystem: injection harness, retry/replay, liveness.
+
+Three cooperating parts (see ``docs/fault_tolerance.md`` for the failure
+model and cookbook):
+
+* :mod:`multiverso_tpu.fault.inject` — a seeded, rule-based transport
+  proxy (drop/delay/dup/reorder/partition) switchable via the
+  ``fault_spec``/``fault_seed`` flags, so any test or bench runs under
+  chaos. The correctness tool that makes the rest verifiable.
+* :mod:`multiverso_tpu.fault.retry` — exponential backoff with jitter and
+  deadlines for remote clients; paired with idempotent request ids and the
+  server-side dedup window so a retried Add applies exactly once
+  (Li et al., OSDI'14: replayable, idempotent messages).
+* :mod:`multiverso_tpu.fault.detector` — heartbeat/lease tracking; the
+  sync watchdog escalates from logging a stall to EVICTING a worker whose
+  lease expired, so BSP/SSP rounds no longer deadlock on a crashed peer
+  (the condition under which Ho et al.'s SSP gate is safe in production).
+
+Counters (``CLIENT_RETRIES``, ``CLIENT_RECONNECTS``, ``SERVER_DEDUP_HITS``,
+``WORKER_EVICTIONS``, ``FAULT_INJECTED_*``) register in the dashboard so
+chaos runs are observable.
+"""
+
+from multiverso_tpu.fault.detector import LivenessDetector  # noqa: F401
+from multiverso_tpu.fault.inject import (  # noqa: F401
+    ChaosNet, FaultInjector, FaultRule, make_net, parse_fault_spec)
+from multiverso_tpu.fault.retry import RetryPolicy  # noqa: F401

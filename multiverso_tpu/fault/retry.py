@@ -1,0 +1,68 @@
+"""Retry policy: exponential backoff with full jitter under a deadline.
+
+The parameter-server literature (Li et al., OSDI'14) makes fault tolerance
+hinge on *replayable, idempotent* messages: a sender may retry freely
+because the receiver deduplicates. This module is the sender half — the
+backoff schedule remote clients use for reconnect-and-resume and for
+per-request retransmission (:mod:`multiverso_tpu.runtime.remote`). The
+receiver half is the server's req-id dedup window; liveness is
+:mod:`multiverso_tpu.fault.detector`.
+
+Jitter is *full* jitter (uniform in [delay/2, delay]) so a herd of clients
+orphaned by one server restart does not reconnect in lockstep.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Iterator, Optional, Tuple
+
+
+class RetryPolicy:
+    """Backoff schedule: attempt k (k>=1) sleeps ``min(cap, base*2^(k-1))``
+    jittered, attempt 0 runs immediately; the whole sequence stops when
+    ``deadline`` seconds have elapsed. ``deadline=0`` yields NO attempts —
+    the fail-fast escape hatch."""
+
+    def __init__(self, base: float = 0.05, cap: float = 2.0,
+                 deadline: float = 30.0,
+                 rng: Optional[random.Random] = None) -> None:
+        self.base = float(base)
+        self.cap = float(cap)
+        self.deadline = float(deadline)
+        self._rng = rng if rng is not None else random.Random()
+
+    @classmethod
+    def from_flags(cls, deadline: Optional[float] = None) -> "RetryPolicy":
+        from multiverso_tpu import config
+        if deadline is None:
+            deadline = float(config.get_flag("reconnect_deadline_seconds"))
+        return cls(base=float(config.get_flag("retry_base_seconds")),
+                   cap=float(config.get_flag("retry_cap_seconds")),
+                   deadline=deadline)
+
+    def backoff(self, attempt: int) -> float:
+        """Jittered sleep before attempt ``attempt`` (0 -> no sleep)."""
+        if attempt <= 0:
+            return 0.0
+        delay = min(self.cap, self.base * (2.0 ** (attempt - 1)))
+        return delay * (0.5 + 0.5 * self._rng.random())
+
+    def attempts(self) -> Iterator[Tuple[int, float]]:
+        """Yield ``(attempt_index, seconds_remaining)`` pairs, sleeping the
+        jittered backoff between yields; stops once the deadline passes.
+        Callers break out on success."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            remaining = self.deadline - (time.monotonic() - start)
+            if remaining <= 0:
+                return
+            yield attempt, remaining
+            attempt += 1
+            delay = self.backoff(attempt)
+            remaining = self.deadline - (time.monotonic() - start)
+            if remaining <= 0:
+                return
+            time.sleep(min(delay, remaining))

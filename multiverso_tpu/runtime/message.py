@@ -38,6 +38,7 @@ class MsgType(enum.IntEnum):
     Control_Register = 34
     Control_Reply_Register = -34
     Control_Deregister = 35  # graceful client close frees its worker slot
+    Control_Heartbeat = 36  # remote worker lease renewal (fault/detector.py)
 
     @property
     def is_server_bound(self) -> bool:
@@ -68,6 +69,13 @@ class Message:
     type: MsgType = MsgType.Request_Get
     table_id: int = -1
     msg_id: int = 0
+    # Idempotency key for retried wire requests (fault/retry.py): a remote
+    # client stamps every correlated request with a session-unique id so the
+    # server's dedup window applies a replayed Add exactly once. 0 = not
+    # replayable (in-process messages, raw-channel frames, fire-and-forget
+    # control traffic). Distinct from msg_id, which stays the reply
+    # correlation key.
+    req_id: int = 0
     data: List[Any] = field(default_factory=list)
 
     def create_reply(self) -> "Message":
@@ -78,4 +86,5 @@ class Message:
             type=MsgType(-int(self.type)),
             table_id=self.table_id,
             msg_id=self.msg_id,
+            req_id=self.req_id,
         )

@@ -37,7 +37,13 @@ from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
 _MAGIC = 0x4D565450  # 'MVTP'
-_HEADER = struct.Struct("<IBiiiiqi")  # magic, channel, src, dst, type, table, msg_id, nblobs
+# Wire version — the ONE place the frame layout is bumped. v2 grew the
+# req_id field (idempotent replay, fault/retry.py); both sides of every
+# deployment ship from this repo, so a mismatch is a config error and the
+# connection is dropped loudly rather than negotiated.
+_VERSION = 2
+# magic, version, channel, src, dst, type, table, msg_id, req_id, nblobs
+_HEADER = struct.Struct("<IBBiiiiqqi")
 _BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
 
 
@@ -144,6 +150,14 @@ class TcpNet:
     def finalize(self) -> None:
         self._active = False
         if self._listener is not None:
+            # shutdown() first: close() alone leaves the accept thread
+            # blocked inside accept(), and that in-flight syscall pins the
+            # open file description — the port would stay in LISTEN and a
+            # server restart could not rebind it (fault recovery path)
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
@@ -213,9 +227,9 @@ class TcpNet:
             head, payload = _pack_blob(np.asarray(arr))
             parts.append(head)
             parts.append(payload)
-        parts[0] = _HEADER.pack(_MAGIC, channel, msg.src, msg.dst,
+        parts[0] = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src, msg.dst,
                                 int(msg.type), msg.table_id, msg.msg_id,
-                                len(msg.data))
+                                msg.req_id, len(msg.data))
         return b"".join(parts)
 
     def _send(self, msg: Message, channel: int) -> int:
@@ -234,6 +248,10 @@ class TcpNet:
             log.fatal("net: no endpoint for rank %d", rank)
         host, port = self._endpoints[rank].rsplit(":", 1)
         sock = socket.create_connection((host, int(port)), timeout=30)
+        # the connect timeout must not linger as an IO timeout: an idle
+        # connection's recv loop would otherwise die after 30s of silence
+        # and fake a peer loss
+        sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._conn_lock:
             # keep the first established connection per peer
@@ -267,10 +285,15 @@ class TcpNet:
         try:
             while self._active:
                 head = _read_exact(conn, _HEADER.size)
-                magic, channel, src, dst, mtype, table_id, msg_id, nblobs = (
-                    _HEADER.unpack(head))
+                (magic, version, channel, src, dst, mtype, table_id, msg_id,
+                 req_id, nblobs) = _HEADER.unpack(head)
                 if magic != _MAGIC:
                     log.error("net: bad frame magic %x", magic)
+                    self._drop_conn(conn, srcs_seen)
+                    return
+                if version != _VERSION:
+                    log.error("net: wire version %d from peer (want %d)",
+                              version, _VERSION)
                     self._drop_conn(conn, srcs_seen)
                     return
                 srcs_seen.add(src)
@@ -285,7 +308,8 @@ class TcpNet:
                         payload, dtype=np.dtype(dt.decode().strip())
                     ).reshape(shape).copy())
                 msg = Message(src=src, dst=dst, type=MsgType(mtype),
-                              table_id=table_id, msg_id=msg_id, data=blobs)
+                              table_id=table_id, msg_id=msg_id,
+                              req_id=req_id, data=blobs)
                 msg._conn = conn  # reply path for listener-less peers
                 if channel == 1:
                     self._raw.setdefault(src, MtQueue()).push(msg)

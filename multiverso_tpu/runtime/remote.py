@@ -18,6 +18,17 @@ shaping code with the in-process workers — only the channel differs — so the
 BSP clocks, per-worker updater state, and option envelopes behave
 identically across the wire.
 
+Fault story (:mod:`multiverso_tpu.fault`, Li et al. OSDI'14's replayable
+idempotent messages): every correlated request carries a session-unique
+``req_id``; the server keeps a bounded dedup window mapping req_id to the
+cached reply, so a client may retransmit freely — on a reply timeout
+(drops, duplicated frames) or after reconnect-and-resume (connection loss,
+server restart) — and a retried Add is applied exactly once. Remote
+workers renew a lease with heartbeats; the sync watchdog evicts expired
+leases from the BSP/SSP clock gates (:mod:`multiverso_tpu.fault.detector`).
+Transports are built through :func:`multiverso_tpu.fault.inject.make_net`,
+so the whole path runs under seeded fault injection via config flags.
+
 Payloads ride the :mod:`multiverso_tpu.runtime.wire` codec; float32 arrays
 are SparseFilter-compressed when the ``wire_compression`` flag is on and the
 sparse form is smaller (the reference applied SparseFilter on exactly these
@@ -26,14 +37,21 @@ host hops, ``src/table/sparse_matrix_table.cpp:147-153``).
 
 from __future__ import annotations
 
+import itertools
+import random
 import threading
+import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from multiverso_tpu import config, log
+from multiverso_tpu.dashboard import count
+from multiverso_tpu.fault.detector import LivenessDetector
+from multiverso_tpu.fault.inject import make_net
+from multiverso_tpu.fault.retry import RetryPolicy
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
-from multiverso_tpu.runtime.net import TcpNet
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.tables.array_table import ArrayWorker
 from multiverso_tpu.tables.base import Completion, WorkerTable
@@ -50,14 +68,21 @@ config.define_bool("wire_compression", True,
 
 # -- server side -------------------------------------------------------------
 
+# dedup-window sentinel: the request arrived and is being processed; a
+# replay seen now is swallowed (the original's completion will reply)
+_INFLIGHT = object()
+
+
 class _NetCompletion:
-    """Dispatcher completion that frames the result back over the wire."""
+    """Dispatcher completion that frames the result back over the wire and
+    records it in the server's dedup window, so a replay of the same
+    request re-sends this reply instead of re-applying the request."""
 
-    __slots__ = ("_net", "_conn", "_template", "_compress")
+    __slots__ = ("_server", "_conn", "_template", "_compress")
 
-    def __init__(self, net: TcpNet, conn, template: Message,
+    def __init__(self, server: "RemoteServer", conn, template: Message,
                  compress: bool) -> None:
-        self._net = net
+        self._server = server
         self._conn = conn
         self._template = template
         self._compress = compress
@@ -65,12 +90,15 @@ class _NetCompletion:
     def _reply(self, msg_type: MsgType, payload: Any) -> None:
         t = self._template
         msg = Message(src=t.dst, dst=t.src, type=msg_type,
-                      table_id=t.table_id, msg_id=t.msg_id,
+                      table_id=t.table_id, msg_id=t.msg_id, req_id=t.req_id,
                       data=wire.encode(payload, compress=self._compress))
+        self._server._dedup_store(t.req_id, msg)
         try:
-            self._net.send_via(self._conn, msg)
+            self._server._net.send_via(self._conn, msg)
         except OSError as exc:
-            log.error("remote: reply to worker %d failed: %r", t.src, exc)
+            log.error("remote: reply to worker %d failed: %r (the client "
+                      "recovers it via retransmit + the dedup cache)",
+                      t.src, exc)
 
     def done(self, result: Any) -> None:
         reply_type = (MsgType.Reply_Get
@@ -87,7 +115,7 @@ class RemoteServer:
 
     def __init__(self, zoo) -> None:
         self._zoo = zoo
-        self._net = TcpNet()
+        self._net = make_net()  # ChaosNet under fault_spec, else TcpNet
         self._thread: Optional[threading.Thread] = None
         self._wid_lock = threading.Lock()
         self._next_remote = 0
@@ -96,21 +124,68 @@ class RemoteServer:
         # only from that connection, so a replayed/forged deregister cannot
         # free a slot that was re-leased to a different client
         self._leased: Dict[int, Any] = {}
+        # client session nonce -> worker id: the authority for
+        # reconnect-and-resume (a client proves slot ownership with the
+        # session it registered under, not with its — dead — connection)
+        self._sessions: Dict[int, int] = {}
+        # bounded idempotent-replay window: req_id -> _INFLIGHT | cached
+        # reply Message (re-sent verbatim over the replaying frame's conn)
+        self._dedup: "OrderedDict[int, Any]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self._dedup_max = max(16, int(config.get_flag("dedup_window")))
+        self.liveness = LivenessDetector(
+            float(config.get_flag("lease_seconds")))
         self.endpoint: Optional[str] = None
 
     def serve(self, endpoint: str = "127.0.0.1:0") -> str:
         """Bind + start the pump; returns the dialable endpoint."""
         self.endpoint = self._net.bind(0, endpoint)
+        if self._zoo.server is not None:
+            # the sync watchdog polls this to escalate stalls to evictions
+            self._zoo.server.liveness = self.liveness
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name="mv-remote-serve")
         self._thread.start()
         return self.endpoint
 
     def stop(self) -> None:
+        if (self._zoo.server is not None
+                and self._zoo.server.liveness is self.liveness):
+            self._zoo.server.liveness = None
         self._net.finalize()
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+
+    # -- idempotent replay ---------------------------------------------------
+    def _replayed(self, msg: Message) -> bool:
+        """True → this frame replays an already-seen request: re-send the
+        cached reply (if built) over THIS frame's connection — the original
+        may have gone to a connection that no longer exists — or swallow
+        the duplicate while the original is still in flight."""
+        if msg.req_id == 0:
+            return False
+        with self._dedup_lock:
+            hit = self._dedup.get(msg.req_id)
+            if hit is None:
+                self._dedup[msg.req_id] = _INFLIGHT
+                while len(self._dedup) > self._dedup_max:
+                    self._dedup.popitem(last=False)
+                return False
+        count("SERVER_DEDUP_HITS")
+        if hit is not _INFLIGHT:
+            try:
+                self._net.send_via(msg._conn, hit)
+            except OSError as exc:
+                log.error("remote: dedup re-reply failed: %r", exc)
+        return True
+
+    def _dedup_store(self, req_id: int, reply: Message) -> None:
+        if req_id == 0:
+            return
+        with self._dedup_lock:
+            if req_id in self._dedup:
+                self._dedup[req_id] = reply
 
     # -- pump ---------------------------------------------------------------
     def _pump(self) -> None:
@@ -126,37 +201,21 @@ class RemoteServer:
                 self._handle(msg, compress)
             except Exception as exc:  # noqa: BLE001 — keep serving
                 log.error("remote server: error on %s: %r", msg.type, exc)
-                _NetCompletion(self._net, msg._conn, msg, False).fail(exc)
+                _NetCompletion(self, msg._conn, msg, False).fail(exc)
 
     def _handle(self, msg: Message, compress: bool) -> None:
+        if msg.src >= 0:
+            # ANY frame from a worker renews its lease; dedicated
+            # heartbeats only matter while the client idles or blocks
+            self.liveness.beat(msg.src)
+        if msg.type == MsgType.Control_Heartbeat:
+            return
         if msg.type == MsgType.Control_Register:
-            self._register_client(msg)
+            if not self._replayed(msg):
+                self._register_client(msg)
             return
         if msg.type == MsgType.Control_Deregister:
-            # Graceful close recycles the slot — async server only. The
-            # sync server's per-worker clocks/finished flags are positional
-            # history a newcomer must not inherit, so BSP keeps the
-            # reference's static-membership contract (a departed worker's
-            # slot stays retired; crashed clients are never reclaimed).
-            # Only the connection that leased the slot may free it: a
-            # duplicate, forged, or replayed deregister (src=-1, a local id,
-            # a replay after the slot was re-leased) must not let two later
-            # clients share one worker id. A recycled slot DOES inherit the
-            # departed client's per-worker updater state (momentum/adagrad
-            # accumulators) — deliberate: that state is the slot's
-            # optimization history, exactly what the reference's static
-            # membership kept positional.
-            from multiverso_tpu.runtime.server import SyncServer
-            if not isinstance(self._zoo.server, SyncServer):
-                with self._wid_lock:
-                    slot = int(msg.src)
-                    conn = getattr(msg, "_conn", None)
-                    if conn is not None and self._leased.get(slot) is conn:
-                        del self._leased[slot]
-                        self._free_slots.append(slot)
-                    else:
-                        log.error("remote: ignoring deregister for slot %d "
-                                  "(not leased to this connection)", slot)
+            self._deregister_client(msg)
             return
         if msg.type == MsgType.Server_Finish_Train:
             self._zoo.server.send(Message(
@@ -166,47 +225,123 @@ class RemoteServer:
         if msg.type not in (MsgType.Request_Get, MsgType.Request_Add):
             log.error("remote server: unhandled frame type %s", msg.type)
             return
+        if self._replayed(msg):
+            return
         request = wire.decode(msg.data)
-        completion = _NetCompletion(self._net, msg._conn, msg, compress)
+        completion = _NetCompletion(self, msg._conn, msg, compress)
         self._zoo.server.send(Message(
             src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
             msg_id=msg.msg_id, data=[request, completion]))
 
+    def _deregister_client(self, msg: Message) -> None:
+        # Graceful close. Slot recycling is async-server only: the sync
+        # server's per-worker clocks/finished flags are positional history
+        # a newcomer must not inherit, so BSP keeps the reference's
+        # static-membership contract (a departed worker's slot stays
+        # retired; crashed clients are reclaimed only by lease eviction).
+        # Only the connection that leased the slot may free it: a
+        # duplicate, forged, or replayed deregister (src=-1, a local id,
+        # a replay after the slot was re-leased) must not let two later
+        # clients share one worker id. A recycled slot DOES inherit the
+        # departed client's per-worker updater state (momentum/adagrad
+        # accumulators) — deliberate: that state is the slot's
+        # optimization history, exactly what the reference's static
+        # membership kept positional.
+        from multiverso_tpu.runtime.server import SyncServer
+        slot = int(msg.src)
+        conn = getattr(msg, "_conn", None)
+        with self._wid_lock:
+            if conn is None or self._leased.get(slot) is not conn:
+                log.error("remote: ignoring deregister for slot %d "
+                          "(not leased to this connection)", slot)
+                return
+            self.liveness.forget(slot)
+            # drop session claims on the slot so a stale client cannot
+            # resume a slot later re-leased to someone else
+            self._sessions = {s: w for s, w in self._sessions.items()
+                              if w != slot}
+            if not isinstance(self._zoo.server, SyncServer):
+                del self._leased[slot]
+                self._free_slots.append(slot)
+
+    def _resume_slot(self, session: int, resume: int,
+                     msg: Message) -> Optional[str]:
+        """Validate a reconnect-and-resume claim (``_wid_lock`` held);
+        returns a refusal message or None (granted, caller re-leases).
+        The session nonce — not the connection, which is typically dead —
+        is the authority for slot ownership."""
+        base = self._zoo.num_workers - self._zoo.remote_workers
+        idx = resume - base
+        if not 0 <= idx < self._zoo.remote_workers:
+            return f"cannot resume worker {resume}: not a remote slot"
+        if self.liveness.is_evicted(resume):
+            return (f"worker {resume} was evicted (lease expired); its "
+                    "round-clock history is retired — register fresh")
+        if session and self._sessions.get(session) == resume:
+            return None  # the same client reclaiming its own slot
+        held = self._leased.get(resume)
+        if held is msg._conn:
+            return None  # replayed register on the same connection
+        if held is None:
+            # unleased: a restarted server (empty lease table) or a
+            # gracefully-freed slot; account it as taken
+            if idx >= self._next_remote:
+                for skipped in range(self._next_remote, idx):
+                    self._free_slots.append(base + skipped)
+                self._next_remote = idx + 1
+            elif resume in self._free_slots:
+                self._free_slots.remove(resume)
+            else:
+                return f"worker slot {resume} is not resumable"
+            return None
+        return f"worker slot {resume} is leased to another client"
+
+    def _register_reply(self, msg: Message, payload: Any) -> None:
+        reply = Message(src=msg.dst, dst=msg.src,
+                        type=MsgType.Control_Reply_Register,
+                        msg_id=msg.msg_id, req_id=msg.req_id,
+                        data=wire.encode(payload))
+        self._dedup_store(msg.req_id, reply)
+        self._net.send_via(msg._conn, reply)
+
     def _register_client(self, msg: Message) -> None:
+        info = wire.decode(msg.data)
+        info = info if isinstance(info, dict) else {}
+        session = int(info.get("session", 0))
+        resume = int(info.get("resume", -1))
         base = self._zoo.num_workers - self._zoo.remote_workers
         with self._wid_lock:
-            if self._free_slots:
+            if resume >= 0:
+                refusal = self._resume_slot(session, resume, msg)
+                if refusal is not None:
+                    self._register_reply(msg, {"error": refusal})
+                    return
+                worker_id = resume
+            elif self._free_slots:
                 worker_id = self._free_slots.pop()
-                self._leased[worker_id] = msg._conn
             elif self._next_remote >= self._zoo.remote_workers:
                 # refuse: an out-of-range worker id would alias slot-0
                 # per-worker state and bypass the BSP clocks
-                reply = Message(src=msg.dst, dst=msg.src,
-                                type=MsgType.Control_Reply_Register,
-                                msg_id=msg.msg_id,
-                                data=wire.encode({"error": (
-                                    f"all {self._zoo.remote_workers} remote "
-                                    "worker slots are taken (raise the "
-                                    "remote_workers flag at init)")}))
-                self._net.send_via(msg._conn, reply)
+                self._register_reply(msg, {"error": (
+                    f"all {self._zoo.remote_workers} remote worker slots "
+                    "are taken (raise the remote_workers flag at init)")})
                 return
             else:
                 worker_id = base + self._next_remote
                 self._next_remote += 1
-                self._leased[worker_id] = msg._conn
+            self._leased[worker_id] = msg._conn
+            if session:
+                self._sessions[session] = worker_id
+        self.liveness.register(worker_id)
         directory = []
         # snapshot: create_table on the main thread mutates the dict
         for table_id, table in list(self._zoo.server._tables.items()):
             spec = table.remote_spec()
             if spec is not None:
                 directory.append({"table_id": table_id, **spec})
-        reply = Message(src=msg.dst, dst=msg.src,
-                        type=MsgType.Control_Reply_Register,
-                        msg_id=msg.msg_id,
-                        data=wire.encode({"worker_id": worker_id,
-                                          "num_workers": self._zoo.num_workers,
-                                          "tables": directory}))
-        self._net.send_via(msg._conn, reply)
+        self._register_reply(msg, {"worker_id": worker_id,
+                                   "num_workers": self._zoo.num_workers,
+                                   "tables": directory})
 
 
 # -- client side -------------------------------------------------------------
@@ -228,31 +363,67 @@ class RemoteChannel:
         self._client._send(table_id, msg_type, None, next_msg_id(), None)
 
 
+class _Inflight:
+    """One outstanding correlated request: the framed message (for
+    retransmission) plus its retry clock."""
+
+    __slots__ = ("msg", "sent", "attempts")
+
+    def __init__(self, msg: Message, sent: float) -> None:
+        self.msg = msg
+        self.sent = sent
+        self.attempts = 0
+
+
 class RemoteClient:
-    """Off-mesh table client: register → worker id + table directory."""
+    """Off-mesh table client: register → worker id + table directory.
+
+    Survives faults (``docs/fault_tolerance.md``): correlated requests are
+    kept in an inflight set and retransmitted on reply timeout
+    (``request_retry_seconds``) or after reconnect-and-resume
+    (``reconnect_deadline_seconds``); the server's dedup window keeps every
+    replay idempotent. A maintenance thread renews the worker's lease with
+    heartbeats. ``reconnect_deadline_seconds=0`` restores the fail-fast
+    posture: any connection loss fails all pending requests immediately."""
 
     def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
-        self._net = TcpNet()
+        self._net = make_net()
         self._net.rank = -1
         self._net.connect([endpoint])
         self._pending: Dict[int, Completion] = {}
+        self._inflight: Dict[int, _Inflight] = {}
         self._lock = threading.Lock()
         self._compress = bool(config.get_flag("wire_compression"))
+        # 31-bit nonzero session nonce: req_id = (session << 32) | seq
+        # stays within the header's signed 64-bit field
+        self._session = random.getrandbits(31) | 1
+        self._req_seq = itertools.count(1)
+        self._closed = False
+        self._recovering = False
+        self._recover_lock = threading.Lock()
+        self._stop_maint = threading.Event()
+        self._hb_period = float(config.get_flag("heartbeat_seconds"))
+        self._rto = float(config.get_flag("request_retry_seconds"))
         self._pump_thread = threading.Thread(
             target=self._pump, daemon=True, name="mv-remote-client")
         self._pump_thread.start()
         self.worker_id = -1
         self.directory: List[Dict[str, Any]] = []
         self.num_workers = 0
-        self._closed = False
-        self._register(timeout)
+        try:
+            self._register(timeout)
+        except BaseException:
+            self._net.finalize()
+            raise
         self._channel = RemoteChannel(self)
+        self._start_maintenance()
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        self._stop_maint.set()
         try:
             self._net.send(Message(src=self.worker_id, dst=0,
                                    type=MsgType.Control_Deregister,
@@ -261,16 +432,42 @@ class RemoteClient:
             pass  # server already gone; slot stays leased (static membership)
         self._net.finalize()
 
-    def _register(self, timeout: float) -> None:
+    def _next_req_id(self) -> int:
+        return (self._session << 32) | (next(self._req_seq) & 0xFFFFFFFF)
+
+    def _register(self, timeout: float, resume: bool = False) -> None:
+        """Register (or resume) this client's worker slot. The request is
+        re-sent once a second until the reply lands or ``timeout`` passes —
+        registration rides the same lossy wire as everything else, and the
+        server's dedup window makes the replay idempotent."""
         msg_id = next_msg_id()
         completion = Completion()
         with self._lock:
             self._pending[msg_id] = completion
-        self._net.send(Message(src=-1, dst=0, type=MsgType.Control_Register,
-                               msg_id=msg_id, data=wire.encode(None)))
-        info = completion.wait(timeout)
+        payload: Dict[str, Any] = {"session": self._session}
+        if resume:
+            payload["resume"] = self.worker_id
+        msg = Message(src=self.worker_id if resume else -1, dst=0,
+                      type=MsgType.Control_Register, msg_id=msg_id,
+                      req_id=self._next_req_id(), data=wire.encode(payload))
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._net.send(msg)
+                info = completion.wait(
+                    min(1.0, max(0.05, deadline - time.monotonic())))
+                break
+            except TimeoutError:  # before OSError: TimeoutError IS one
+                if time.monotonic() >= deadline:
+                    with self._lock:
+                        self._pending.pop(msg_id, None)
+                    raise TimeoutError(
+                        "remote registration timed out") from None
+            except OSError:
+                with self._lock:
+                    self._pending.pop(msg_id, None)
+                raise  # caller's retry loop owns the backoff
         if "error" in info:
-            self._net.finalize()
             raise RuntimeError(f"remote registration refused: {info['error']}")
         self.worker_id = int(info["worker_id"])
         self.num_workers = int(info["num_workers"])
@@ -279,29 +476,46 @@ class RemoteClient:
     # -- request path --------------------------------------------------------
     def _send(self, table_id: int, msg_type: MsgType, request: Any,
               msg_id: int, completion: Optional[Completion]) -> None:
-        if completion is not None:
-            with self._lock:
-                self._pending[msg_id] = completion
         data = [] if request is None and msg_type not in (
             MsgType.Request_Get, MsgType.Request_Add) else wire.encode(
                 request, compress=self._compress)
-        self._net.send(Message(src=self.worker_id, dst=0, type=msg_type,
-                               table_id=table_id, msg_id=msg_id, data=data))
+        msg = Message(src=self.worker_id, dst=0, type=msg_type,
+                      table_id=table_id, msg_id=msg_id,
+                      req_id=self._next_req_id() if completion is not None
+                      else 0,
+                      data=data)
+        with self._lock:
+            if completion is not None:
+                self._pending[msg_id] = completion
+                self._inflight[msg_id] = _Inflight(msg, time.monotonic())
+            if self._recovering:
+                # recovery retransmits the whole inflight set (in req_id
+                # order) once re-registered; sending now would race it
+                return
+        try:
+            self._net.send(msg)
+        except OSError:
+            if completion is None:
+                raise  # fire-and-forget posts keep the fail-loud contract
+            self._start_recovery()  # the request stays inflight; recovery
+            # (or its deadline) settles the completion
 
     def _pump(self) -> None:
         while True:
             try:
                 msg = self._net.recv()
             except ConnectionError:
-                self._fail_all(ConnectionError("server connection lost"))
+                if not self._closed:
+                    self._start_recovery()
                 continue
             if msg is None:
                 self._fail_all(ConnectionError("remote client shut down"))
                 return
             with self._lock:
                 completion = self._pending.pop(msg.msg_id, None)
+                self._inflight.pop(msg.msg_id, None)
             if completion is None:
-                continue
+                continue  # duplicate reply (retransmit + dedup): settled
             try:
                 if msg.type == MsgType.Reply_Error:
                     completion.fail(RuntimeError(
@@ -315,10 +529,131 @@ class RemoteClient:
                 # later request forever)
                 completion.fail(exc)
 
+    # -- fault recovery ------------------------------------------------------
+    def _start_recovery(self) -> None:
+        with self._recover_lock:
+            if self._recovering or self._closed:
+                return
+            self._recovering = True
+        threading.Thread(target=self._recover, daemon=True,
+                         name="mv-remote-reconnect").start()
+
+    def _recover(self) -> None:
+        """Reconnect-and-resume: re-register under the same session (the
+        server re-leases the same worker id) with backoff until the
+        deadline, then retransmit every inflight request in issue order —
+        the server's dedup window drops the ones that already applied.
+        Deadline exhaustion (or a refusal — evicted slot, capacity) fails
+        all pending requests with a clean error: the pre-tentpole fail-fast
+        behavior, just ``reconnect_deadline_seconds`` later."""
+        policy = RetryPolicy.from_flags()
+        last_error: BaseException = ConnectionError("connection lost")
+        resumed = False
+        try:
+            for _attempt, remaining in policy.attempts():
+                if self._closed:
+                    return
+                try:
+                    self._register(timeout=min(2.0, max(0.1, remaining)),
+                                   resume=True)
+                except RuntimeError as exc:
+                    self._fail_all(exc)  # refused: permanent, stop retrying
+                    return
+                except (OSError, TimeoutError) as exc:
+                    last_error = exc
+                    continue
+                with self._lock:
+                    backlog = sorted(self._inflight.values(),
+                                     key=lambda f: f.msg.req_id)
+                    # cleared under _lock: a concurrent _send either saw
+                    # _recovering and left its message to this backlog, or
+                    # runs after the backlog went out — never both
+                    self._recovering = False
+                    resumed = True
+                    now = time.monotonic()
+                    for flight in backlog:
+                        flight.attempts += 1
+                        flight.sent = now
+                        try:
+                            self._net.send(flight.msg)
+                        except OSError as exc:
+                            # died again mid-resume: the pump's next
+                            # sentinel starts a fresh recovery; unsent
+                            # entries stay inflight for it
+                            last_error = exc
+                            break
+                count("CLIENT_RECONNECTS")
+                log.info("remote client %d: reconnected, %d request(s) "
+                         "retransmitted", self.worker_id, len(backlog))
+                return
+            self._fail_all(ConnectionError(
+                "server connection lost; reconnect gave up after "
+                f"{policy.deadline:.1f}s (last error: {last_error!r})"))
+        finally:
+            if not resumed:
+                with self._recover_lock:
+                    self._recovering = False
+
+    def _start_maintenance(self) -> None:
+        """Heartbeats (lease renewal) + reply-timeout retransmission; no
+        thread at all when both are disabled."""
+        periods = [p for p in (self._hb_period, self._rto) if p > 0]
+        if not periods:
+            return
+        tick = max(0.05, min(min(periods) / 4.0, 1.0))
+        threading.Thread(target=self._maintain, args=(tick,), daemon=True,
+                         name="mv-remote-maint").start()
+
+    def _maintain(self, tick: float) -> None:
+        last_beat = 0.0
+        while not self._stop_maint.wait(tick):
+            if self._closed:
+                return
+            if self._recovering:
+                continue  # recovery owns the connection right now
+            now = time.monotonic()
+            if (self._hb_period > 0 and self.worker_id >= 0
+                    and now - last_beat >= self._hb_period):
+                last_beat = now
+                try:
+                    self._net.send(Message(
+                        src=self.worker_id, dst=0,
+                        type=MsgType.Control_Heartbeat,
+                        msg_id=next_msg_id()))
+                except OSError:
+                    self._start_recovery()
+                    continue
+            if self._rto > 0:
+                self._retransmit_stale(now)
+
+    def _retransmit_stale(self, now: float) -> None:
+        """Re-send correlated requests whose reply is overdue (per-request
+        exponential backoff on the timeout). Safe against legitimately
+        slow replies — a BSP-gated Get, a busy dispatcher — because the
+        server's dedup window swallows the replay."""
+        with self._lock:
+            if self._recovering:
+                return
+            stale = [f for f in self._inflight.values()
+                     if now - f.sent >= self._rto * min(2 ** f.attempts, 16)]
+            for flight in stale:
+                flight.attempts += 1
+                flight.sent = now
+        for flight in stale:
+            count("CLIENT_RETRIES")
+            log.debug("remote client %d: retransmitting %s (attempt %d)",
+                      self.worker_id, flight.msg.type, flight.attempts)
+            try:
+                self._net.send(flight.msg)
+            except OSError:
+                self._start_recovery()
+                return
+
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
             pending = list(self._pending.values())
             self._pending.clear()
+            self._inflight.clear()
         for completion in pending:
             completion.fail(exc)
 

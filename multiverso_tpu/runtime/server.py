@@ -24,9 +24,23 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from multiverso_tpu import config, log
-from multiverso_tpu.dashboard import monitor
+from multiverso_tpu.dashboard import count, monitor
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
+
+
+class _NullCompletion:
+    """Fire-and-forget completion for internally-generated dispatcher work
+    (watchdog-triggered evictions): errors are logged by the dispatcher's
+    own guard, nobody waits."""
+
+    __slots__ = ()
+
+    def done(self, result) -> None:
+        pass
+
+    def fail(self, error: BaseException) -> None:
+        pass
 
 
 class _ExecWaiter:
@@ -96,6 +110,11 @@ class Server:
         self._queue: MtQueue[Message] = MtQueue()
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        # Heartbeat/lease tracker for remote workers, attached by the
+        # RemoteServer when it starts serving (fault/detector.py); None
+        # when no off-mesh clients exist. Only the sync watchdog acts on
+        # it — async servers have no round gates a dead worker could hold.
+        self.liveness = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -310,6 +329,7 @@ class SyncServer(Server):
     def _watch_stalls(self, period: float) -> None:
         last_snap = None
         while not self._watch_stop.wait(period):
+            self._reap_leases()
             with self._register_lock:
                 tids = list(self._add_clock)
                 snap_add = {t: list(self._add_clock[t]) for t in tids}
@@ -347,6 +367,50 @@ class SyncServer(Server):
                     self.last_stall = report
                     log.error("%s", report)
             last_snap = snap
+
+    def _reap_leases(self) -> None:
+        """Watchdog escalation (reference gap: the stall detector could
+        only log): evict every remote worker whose lease expired. The
+        detector reports each expiry exactly once; the eviction itself
+        mutates clocks, so it runs on the dispatcher thread serialized
+        with table traffic."""
+        liveness = self.liveness
+        if liveness is None:
+            return
+        for worker in liveness.reap():
+            if not 0 <= worker < self.num_workers:
+                continue
+            log.error("sync: lease expired for worker %d — evicting it "
+                      "from the round gates", worker)
+            self.send(Message(
+                src=-1, dst=-1, type=MsgType.Server_Execute,
+                data=[lambda w=worker: self._evict_worker(w),
+                      _NullCompletion()]))
+
+    def _evict_worker(self, worker: int) -> None:
+        """Remove a dead worker from every clock gate (dispatcher thread):
+        mark it finished so ``_min_adds``/``_min_gets`` stop waiting on its
+        clocks, fail-and-release its own deferred requests (their replies
+        have nowhere to go — the completions log, nobody hangs), and drain
+        so survivors' gated rounds proceed. BSP and SSP both recover
+        through this path; an evicted worker's slot stays retired (its
+        clock history is positional, like the deregister contract)."""
+        if self._finished[worker]:
+            return
+        self._finished[worker] = True
+        count("WORKER_EVICTIONS")
+        exc = ConnectionError(
+            f"worker {worker} evicted: lease expired (crashed or "
+            "partitioned beyond lease_seconds)")
+        for tid in list(self._tables):
+            for pending in (self._pending_add, self._pending_get):
+                mine = [m for m in pending[tid] if m.src == worker]
+                if mine:
+                    pending[tid] = [m for m in pending[tid]
+                                    if m.src != worker]
+                    for msg in mine:
+                        msg.data[-1].fail(exc)
+            self._drain(tid)
 
     def register_table(self, server_table) -> int:
         table_id = super().register_table(server_table)

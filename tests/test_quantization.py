@@ -1,0 +1,122 @@
+"""Direct coverage for utils/quantization.py: the 1/2/4/8-bit delta codec
+and the ErrorFeedback residual accumulator — previously exercised only
+indirectly through test_native.py / test_lr_io.py."""
+
+import numpy as np
+import pytest
+
+from multiverso_tpu.utils import quantization as q
+
+
+BITS = (1, 2, 4, 8)
+# deliberately non-multiples of the per-byte packing factor (8/bits)
+LENGTHS = (1, 3, 7, 13, 64, 100, 1000, 1023)
+
+
+@pytest.mark.parametrize("bits", BITS)
+@pytest.mark.parametrize("n", LENGTHS)
+def test_quant_roundtrip_error_bound(bits, n):
+    """Decode error is bounded by step/2 at every length, including
+    lengths that leave a partially-filled trailing byte."""
+    rng = np.random.default_rng(bits * 1000 + n)
+    x = (rng.normal(size=n) * 5).astype(np.float32)
+    payload = q.quant_encode(x, bits, force_numpy=True)
+    dec = q.quant_decode(payload, n, force_numpy=True)
+    assert dec.dtype == np.float32 and dec.shape == (n,)
+    step = np.frombuffer(payload, np.float32, 1, offset=20)[0]
+    assert np.abs(dec - x).max() <= step / 2 + 1e-6
+
+
+@pytest.mark.parametrize("bits", BITS)
+def test_quant_payload_size(bits):
+    """Layout contract: 24-byte header + ceil(n * bits / 8) packed bytes."""
+    for n in LENGTHS:
+        x = np.arange(n, dtype=np.float32)
+        payload = q.quant_encode(x, bits, force_numpy=True)
+        assert len(payload) == 24 + -(-n * bits // 8), (bits, n)
+
+
+@pytest.mark.parametrize("bits", BITS)
+def test_quant_constant_and_extreme_values(bits):
+    # constant array: step == 0 -> exact reconstruction
+    c = np.full(33, -7.25, np.float32)
+    np.testing.assert_array_equal(
+        q.quant_decode(q.quant_encode(c, bits), 33), c)
+    # endpoints of the range always reconstruct exactly (codes 0 and max)
+    x = np.array([-100.0, 100.0] + [0.0] * 9, np.float32)
+    dec = q.quant_decode(q.quant_encode(x, bits), len(x))
+    assert dec[0] == -100.0
+    assert dec[1] == pytest.approx(100.0, abs=1e-3)
+
+
+def test_quant_rejects_bad_bits_and_payloads():
+    x = np.ones(8, np.float32)
+    with pytest.raises(ValueError):
+        q.quant_encode(x, 3)
+    payload = q.quant_encode(x, 4)
+    with pytest.raises(ValueError):
+        q.quant_decode(payload, 9)  # count mismatch
+    with pytest.raises(ValueError):
+        q.quant_decode(b"\x00" * len(payload), 8)  # bad magic
+
+
+@pytest.mark.parametrize("bits", BITS)
+def test_error_feedback_residual_invariant(bits):
+    """The 1-bit-SGD convergence property, as an exact invariant: after
+    any number of pushes, (sum of decoded pushes) + residual == (sum of
+    raw deltas) — quantization error is never lost, only deferred."""
+    rng = np.random.default_rng(bits)
+    shape = (6, 5)
+    ef = q.ErrorFeedback(shape, bits)
+    cum_raw = np.zeros(shape, np.float64)
+    cum_dec = np.zeros(shape, np.float64)
+    for _ in range(50):
+        delta = rng.normal(size=shape).astype(np.float32)
+        qd = ef.compress(delta)
+        dec = q.quant_decode(qd.payload, delta.size).reshape(shape)
+        cum_raw += delta
+        cum_dec += dec
+        np.testing.assert_allclose(cum_dec + ef.residual, cum_raw,
+                                   atol=1e-3)
+    # and the residual itself stays bounded by one quantization step of
+    # the last push (error feedback does not accumulate unboundedly)
+    last_step = np.frombuffer(qd.payload, np.float32, 1, offset=20)[0]
+    assert np.abs(ef.residual).max() <= last_step / 2 + 1e-6
+
+
+def test_error_feedback_row_addressed_residuals():
+    """ids-based compression reads/writes only the touched rows'
+    residuals; untouched rows keep theirs verbatim."""
+    ef = q.ErrorFeedback((8, 4), 2)
+    rng = np.random.default_rng(5)
+    first = rng.normal(size=(8, 4)).astype(np.float32)
+    ef.compress(first)  # seed every row's residual
+    before = ef.residual.copy()
+    ids = np.array([1, 6], np.int64)
+    ef.compress(rng.normal(size=(2, 4)).astype(np.float32), ids=ids)
+    untouched = np.setdiff1d(np.arange(8), ids)
+    np.testing.assert_array_equal(ef.residual[untouched], before[untouched])
+    assert not np.array_equal(ef.residual[ids], before[ids])
+
+
+def test_error_feedback_beats_plain_quantization():
+    """Accumulating a constant gradient at 1 bit: with error feedback the
+    accumulated table tracks the true sum; without it the bias is
+    unbounded. The property that makes quantized pushes converge."""
+    steps, dim = 200, 16
+    rng = np.random.default_rng(11)
+    grad = rng.normal(size=dim).astype(np.float32)
+
+    ef = q.ErrorFeedback((dim,), 1)
+    with_ef = np.zeros(dim, np.float64)
+    plain = np.zeros(dim, np.float64)
+    for _ in range(steps):
+        qd = ef.compress(grad)
+        with_ef += q.quant_decode(qd.payload, dim)
+        plain += q.quant_decode(q.quant_encode(grad, 1), dim)
+    true = grad.astype(np.float64) * steps
+    err_ef = np.abs(with_ef - true).max()
+    err_plain = np.abs(plain - true).max()
+    assert err_ef < err_plain / 10, (err_ef, err_plain)
+    # bounded by a few quantization steps, not growing linearly in `steps`
+    assert err_ef < 10.0, err_ef
