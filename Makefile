@@ -6,7 +6,9 @@
 #   make check          native build + tests + multi-chip dryrun + bench
 #   make native         just the C++ layer (libmultiverso_tpu.so + C client)
 #   make test           just the suite (8-device virtual CPU mesh)
-#   make chaos          the fault-injection suite under a fixed seed
+#   make chaos          fault-injection + durability suites, fixed seed
+#                       (CHAOS_EXTRA_SPEC appends rules, e.g. corrupt mode)
+#   make failover       crash-point recovery + warm-standby failover smoke
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
 
@@ -14,7 +16,7 @@ PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos native test dryrun bench clean
+.PHONY: check chaos failover native test dryrun bench clean
 
 check: native test dryrun bench
 
@@ -28,7 +30,14 @@ test: native
 
 chaos:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
-		tests/test_fault.py -q -p no:cacheprovider -p no:randomly
+		tests/test_fault.py tests/test_durable.py -q \
+		-k "not crash_point and not failover" \
+		-p no:cacheprovider -p no:randomly
+
+failover:
+	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
+		tests/test_durable.py -q -k "crash_point or failover" \
+		-p no:cacheprovider -p no:randomly
 
 dryrun:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"

@@ -201,14 +201,34 @@ def serve(endpoint: str = "127.0.0.1:0") -> str:
     """Start serving this process's tables to remote clients; returns the
     dialable endpoint (pass port 0 for ephemeral). Set the
     ``remote_workers`` flag at init so BSP clocks and per-worker updater
-    state cover the remote clients."""
+    state cover the remote clients.
+
+    With the ``wal_dir`` flag set, serving is durable: every remote Add is
+    write-ahead-logged before its ACK, and any dedup seeds left by
+    ``durable_recover()`` (or a standby's replication tail) repopulate the
+    idempotent-replay window so exactly-once holds across the restart."""
     zoo = Zoo.instance()
     if not zoo.started or zoo.server is None:
         log.fatal("serve: init() the PS runtime first (not available in ma mode)")
     if zoo.remote_server is None:
+        wal_dir = str(get_flag("wal_dir"))
+        if wal_dir and zoo.server.wal is None:
+            from multiverso_tpu.durable.wal import WalWriter
+            zoo.server.wal = WalWriter(wal_dir)
         from multiverso_tpu.runtime.remote import RemoteServer
         zoo.remote_server = RemoteServer(zoo)
-        return zoo.remote_server.serve(endpoint)
+        if zoo._dedup_seeds:
+            zoo.remote_server.seed_dedup(zoo._dedup_seeds)
+            zoo._dedup_seeds = None
+        try:
+            return zoo.remote_server.serve(endpoint)
+        except OSError:
+            # bind failed (port still held): leave no half-serving state
+            # behind so a retry — the standby's failover loop — can call
+            # serve() again
+            zoo.remote_server.stop()
+            zoo.remote_server = None
+            raise
     return zoo.remote_server.endpoint
 
 
@@ -222,12 +242,56 @@ def remote_connect(endpoint: str, timeout: float = 30.0):
 def stop_serving() -> None:
     """Stop the remote table server while keeping the runtime up. A later
     ``serve()`` binds fresh — the server-restart recovery path: restart,
-    ``checkpoint.restore_tables(...)``, ``serve()`` on the old endpoint,
-    and reconnecting clients resume (see docs/fault_tolerance.md)."""
+    ``checkpoint.restore_tables(...)`` (or ``durable_recover()``),
+    ``serve()`` on the old endpoint, and reconnecting clients resume (see
+    docs/fault_tolerance.md)."""
     zoo = Zoo.instance()
     if zoo.remote_server is not None:
         zoo.remote_server.stop()
         zoo.remote_server = None
+    if zoo.server is not None and zoo.server.wal is not None:
+        zoo.server.wal.close()
+        zoo.server.wal = None
+
+
+def durable_recover(tables: Optional[Sequence[Any]] = None,
+                    directory: Optional[str] = None):
+    """Exactly-once restart recovery (docs/fault_tolerance.md §7): load
+    the manifest snapshot, replay the WAL — truncating any torn tail —
+    and stage the replayed req-ids so the next ``serve()`` rebuilds its
+    dedup window. Call after ``create_table`` (same order as before the
+    crash) and BEFORE ``serve()``. Returns the
+    :class:`~multiverso_tpu.durable.wal.RecoveryResult`."""
+    from multiverso_tpu.durable.wal import recover
+    zoo = Zoo.instance()
+    directory = directory or str(get_flag("wal_dir"))
+    if not directory:
+        log.fatal("durable_recover: pass a directory or set the wal_dir "
+                  "flag")
+    source = list(tables) if tables is not None else list(zoo._worker_tables)
+    result = recover(source, directory)
+    zoo._dedup_seeds = result.seeds
+    return result
+
+
+def wal_writer():
+    """The serving process's WAL writer (None until ``serve()`` runs with
+    the ``wal_dir`` flag set) — pass it to ``CheckpointDriver(...,
+    wal=mv.wal_writer())`` so snapshots compact the log."""
+    zoo = Zoo.instance()
+    return zoo.server.wal if zoo.server is not None else None
+
+
+def warm_standby(primary_endpoint: str, service_endpoint: str,
+                 tables: Optional[Sequence[Any]] = None,
+                 lease_seconds: Optional[float] = None):
+    """Start a warm standby tailing ``primary_endpoint``'s WAL; on primary
+    lease expiry it binds ``service_endpoint`` and clients fail over
+    transparently (durable/standby.py). Returns the started
+    :class:`~multiverso_tpu.durable.standby.WarmStandby`."""
+    from multiverso_tpu.durable.standby import WarmStandby
+    return WarmStandby(primary_endpoint, service_endpoint, tables=tables,
+                       lease_seconds=lease_seconds).start()
 
 
 # -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------

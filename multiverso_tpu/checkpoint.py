@@ -15,6 +15,7 @@ every N seconds or every N steps to ``<uri>/table_<id>.mvckpt``.
 
 from __future__ import annotations
 
+import os
 import struct
 import threading
 from typing import List, Optional
@@ -117,11 +118,18 @@ def _run_serialized(fn):
 
 
 def store_table(table, address: str) -> None:
-    """Store one table (worker or server handle) to a URI."""
+    """Store one table (worker or server handle) to a URI. Atomic: the
+    bytes land in a temp sibling and commit with a rename, so a crash
+    mid-write never leaves a truncated snapshot at the final name (which
+    ``restore_tables`` would hit as a fatal bad-magic error, defeating
+    restart recovery)."""
     _require_leader("snapshot")
     server = getattr(table, "_server_table", table)
-    with mv_io.get_stream(address, "w") as stream:
+    fs = mv_io.fs_for(address)
+    tmp = f"{address}.tmp-{os.getpid()}"
+    with mv_io.get_stream(tmp, "w") as stream:
         _run_serialized(lambda: server.store(stream))
+    fs.replace(tmp, address)
 
 
 def load_table(table, address: str) -> None:
@@ -159,13 +167,29 @@ class CheckpointDriver:
     ``directory`` is a URI: any registered scheme works (``file://`` local,
     ``mvfs://host:port/run`` remote — the reference checkpointed through its
     Stream layer to local or HDFS storage the same way, io.cpp:8-23).
+
+    ``wal``: a :class:`multiverso_tpu.durable.wal.WalWriter`
+    (``mv.wal_writer()`` on a serving process) switches snapshots to the
+    durable protocol — one dispatcher-serialized block that rotates the
+    log, stores every table into a fresh ``gen_<g>/`` directory, commits
+    the MANIFEST, and retires segments/generations older than the
+    snapshot. Restart recovery for that layout is ``mv.durable_recover``
+    (snapshot + WAL replay), not :meth:`restore`.
     """
 
     def __init__(self, tables: List, directory: str,
                  interval_steps: Optional[int] = None,
-                 interval_seconds: Optional[float] = None) -> None:
+                 interval_seconds: Optional[float] = None,
+                 wal=None) -> None:
         self.tables = list(tables)
         self.directory = directory
+        self.wal = wal
+        if wal is not None and wal.directory != directory:
+            # one root holds MANIFEST + gen_<g>/ + wal/ — recovery reads
+            # them as a unit, so a split layout could never be replayed
+            log.fatal("CheckpointDriver: directory %r must equal the WAL "
+                      "root %r (MANIFEST, snapshots and segments are one "
+                      "recovery unit)", directory, wal.directory)
         self.interval_steps = interval_steps
         self.interval_seconds = interval_seconds
         self._fs = mv_io.fs_for(directory)
@@ -193,15 +217,39 @@ class CheckpointDriver:
 
     def snapshot(self) -> None:
         with self._lock:
+            if self.wal is not None:
+                self._durable_snapshot()
+                return
             for table in self.tables:
                 server = getattr(table, "_server_table", table)
                 tid = getattr(server, "table_id", 0)
-                final = mv_io.join(self.directory, f"table_{tid}.mvckpt")
-                tmp = final + ".tmp"
-                store_table(table, tmp)
-                self._fs.replace(tmp, final)
+                store_table(table, mv_io.join(self.directory,
+                                              f"table_{tid}.mvckpt"))
             log.debug("checkpoint: snapshot of %d tables -> %s",
                       len(self.tables), self.directory)
+
+    def _durable_snapshot(self) -> None:
+        """Snapshot + log compaction as ONE dispatcher-serialized block:
+        no add can land between the rotation and the stores, so segments
+        >= the rotation point contain exactly the post-snapshot adds.
+        The MANIFEST commit is the atomic switch; a crash anywhere in
+        here leaves the previous (generation, first_segment) pair live
+        and fully replayable."""
+        def run():
+            first_segment = self.wal.rotate()
+            generation = self.wal.generation + 1
+            gen_dir = mv_io.join(self.directory, f"gen_{generation}")
+            self._fs.makedirs(gen_dir)
+            for table in self.tables:
+                server = getattr(table, "_server_table", table)
+                tid = getattr(server, "table_id", 0)
+                store_table(table, mv_io.join(gen_dir,
+                                              f"table_{tid}.mvckpt"))
+            self.wal.commit_snapshot(generation, first_segment)
+        _run_serialized(run)
+        log.debug("checkpoint: durable snapshot of %d tables -> %s "
+                  "(generation %d)", len(self.tables), self.directory,
+                  self.wal.generation)
 
     def restore(self) -> bool:
         """Load the latest snapshot; returns False when none exists."""

@@ -224,3 +224,17 @@ define_double("lease_seconds", 10.0,
 define_int("dedup_window", 4096,
            "server-side request-id dedup window (entries) bounding the "
            "idempotent-replay cache for retried remote requests")
+
+# Durability subsystem (multiverso_tpu/durable/): WAL + restart recovery +
+# warm-standby failover (docs/fault_tolerance.md §7).
+define_string("wal_dir", "",
+              "durability root: when set, serve() write-ahead-logs every "
+              "remote Add (CRC-checksummed records under <wal_dir>/wal/) "
+              "before it is ACKed; restart recovery = mv.durable_recover() "
+              "(snapshot + WAL replay + dedup-window rebuild), compaction "
+              "= CheckpointDriver(..., wal=mv.wal_writer()). Empty disables")
+define_string("wal_sync", "batch",
+              "WAL durability barrier per append: none (buffered — the "
+              "tail can be lost even to a process crash), batch (flush to "
+              "the OS — survives kill -9, not power loss; the default), "
+              "always (fsync — survives power loss, slowest)")

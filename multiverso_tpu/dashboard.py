@@ -135,6 +135,30 @@ class Dashboard:
         return ctr.value if ctr is not None else 0
 
     @classmethod
+    def render(cls) -> str:
+        """Operator-facing text dump — aligned monitor/counter tables an
+        operator can read off a log or a debug endpoint without touching
+        the Python API (returned, never printed; ``display()`` keeps the
+        reference's print-and-return contract)."""
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+            counters = list(cls._counters.values())
+        lines = ["== dashboard =="]
+        if monitors:
+            lines.append(f"{'section':<36} {'count':>10} {'total_ms':>12} "
+                         f"{'avg_ms':>10}")
+            for m in monitors:
+                lines.append(f"{m.name:<36} {m.count:>10} "
+                             f"{m.elapse_ms:>12.3f} {m.average_ms:>10.3f}")
+        if counters:
+            lines.append(f"{'counter':<36} {'value':>10}")
+            for c in counters:
+                lines.append(f"{c.name:<36} {c.value:>10}")
+        if not monitors and not counters:
+            lines.append("(no monitors or counters recorded)")
+        return "\n".join(lines)
+
+    @classmethod
     def display(cls) -> str:
         with cls._lock:
             lines = ["--------------Dashboard--------------------"]

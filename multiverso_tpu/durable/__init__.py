@@ -1,0 +1,25 @@
+"""Durability subsystem: write-ahead logging, exactly-once restart
+recovery, and warm-standby failover.
+
+The missing half of the fault story (Li et al., OSDI'14): PR 1 made the
+*wire* survive lost packets and dead workers, but the serving process was
+still a single point of data loss — a crash discarded every acknowledged
+Add since the last periodic snapshot, and the req-id dedup window died
+with the process. This package closes that:
+
+* :mod:`~multiverso_tpu.durable.wal` — per-table write-ahead log over the
+  Stream layer (CRC-checksummed, length-prefixed records appended on the
+  dispatcher thread before an Add is ACKed), snapshot-coupled segment
+  rotation/compaction, and ``recover()`` = snapshot + WAL replay +
+  dedup-window reconstruction, so exactly-once holds ACROSS restarts.
+* :mod:`~multiverso_tpu.durable.standby` — a warm-standby server that
+  tails the primary's WAL over a replication stream, detects primary
+  death by lease expiry, and binds the service endpoint so client
+  reconnect logic resumes against it transparently.
+
+See docs/fault_tolerance.md §7 for the operator story.
+"""
+
+from multiverso_tpu.durable.wal import (  # noqa: F401
+    RecoveryResult, WalRecord, WalWriter, read_manifest, recover)
+from multiverso_tpu.durable.standby import WarmStandby  # noqa: F401

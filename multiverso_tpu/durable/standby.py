@@ -1,0 +1,238 @@
+"""Warm-standby failover: a second process that tails the primary's WAL.
+
+Dean et al. (NIPS 2012) motivate the warm replica: async training at real
+traffic cannot afford a cold restart — the replacement must already hold
+the tables when the primary dies. :class:`WarmStandby` delivers that on
+the existing wire machinery:
+
+1. **Subscribe** — dial the primary and send ``Control_Replicate``; the
+   reply is a quiesced full-state transfer (every table's checkpoint
+   bytes + the Add half of the req-id dedup window).
+2. **Tail** — the primary forwards every durable WAL append as a
+   ``Control_Wal_Record`` frame; the standby applies it to its own tables
+   on its dispatcher thread and accumulates the ``(req_id, worker,
+   msg_id)`` seeds. Because the primary writes the replication frame
+   before the client's ACK frame, an acknowledged Add is always on the
+   standby's socket before the primary can die.
+3. **Detect** — the primary's liveness rides a lease
+   (:class:`~multiverso_tpu.fault.detector.LivenessDetector`): every
+   record or heartbeat renews it; on connection loss the standby
+   re-subscribes (full state transfer again — cheap insurance against a
+   blip) while the lease keeps ticking.
+4. **Take over** — when the lease expires, the standby binds the service
+   endpoint (``mv.serve``) with its accumulated dedup seeds. Existing
+   client retry/reconnect logic resumes against it transparently: resume
+   claims are granted (fresh lease table), in-flight Adds retransmit, and
+   the seeded dedup window keeps every replayed Add exactly-once.
+
+The service endpoint must be one the clients can re-dial — same host:port
+(this module's tests), a VIP, or DNS that fails over with the role.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from multiverso_tpu import config, log
+from multiverso_tpu import io as mv_io
+from multiverso_tpu.dashboard import count
+from multiverso_tpu.fault.detector import LivenessDetector
+from multiverso_tpu.fault.inject import make_net
+from multiverso_tpu.runtime import wire
+from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
+
+_PRIMARY = 0  # the lease id the primary is tracked under
+
+
+class WarmStandby:
+    """Replicates a serving primary and takes over its endpoint on death.
+
+    Construct AFTER ``mv.init`` + ``mv.create_table`` (same flags and
+    table order as the primary, so table ids and worker-slot arithmetic
+    line up), then ``start()``. ``wait_failover()`` blocks until takeover;
+    ``stop()`` abandons the standby role cleanly.
+    """
+
+    def __init__(self, primary_endpoint: str, service_endpoint: str,
+                 tables: Optional[List[Any]] = None,
+                 lease_seconds: Optional[float] = None) -> None:
+        from multiverso_tpu.runtime.zoo import Zoo
+        self._zoo = Zoo.instance()
+        if not self._zoo.started or self._zoo.server is None:
+            log.fatal("WarmStandby: init() the PS runtime first")
+        self._primary_endpoint = primary_endpoint
+        self._service_endpoint = service_endpoint
+        source = tables if tables is not None else self._zoo._worker_tables
+        self._tables: Dict[int, Any] = {}
+        for table in source:
+            server_table = getattr(table, "_server_table", table)
+            self._tables[int(getattr(server_table, "table_id", 0))] = \
+                server_table
+        self._detector = LivenessDetector(
+            float(lease_seconds if lease_seconds is not None
+                  else config.get_flag("lease_seconds")))
+        self._seeds: List[Tuple[int, int, int]] = []
+        self.records_applied = 0
+        self.endpoint: Optional[str] = None
+        self.took_over = threading.Event()
+        self.synced = threading.Event()
+        self._stop = threading.Event()
+        self._net = None
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "WarmStandby":
+        self._net = make_net()
+        self._net.rank = -1
+        self._net.connect([self._primary_endpoint])
+        self._send_subscribe()  # raises if the primary is unreachable now
+        self._detector.register(_PRIMARY)
+        for name, target in (("mv-standby-pump", self._pump),
+                             ("mv-standby-watch", self._watch)):
+            thread = threading.Thread(target=target, daemon=True, name=name)
+            thread.start()
+            self._threads.append(thread)
+        return self
+
+    def stop(self) -> None:
+        """Abandon the standby role (no takeover)."""
+        self._stop.set()
+        if self._net is not None:
+            self._net.finalize()
+        for thread in self._threads:
+            thread.join(timeout=10)
+        self._threads.clear()
+
+    def wait_failover(self, timeout: Optional[float] = None) -> str:
+        """Block until takeover; returns the bound service endpoint."""
+        if not self.took_over.wait(timeout):
+            raise TimeoutError("standby: no failover within the timeout "
+                               "(primary still alive?)")
+        return self.endpoint
+
+    # -- replication stream --------------------------------------------------
+    def _send_subscribe(self) -> None:
+        self._net.send(Message(src=-1, dst=0,
+                               type=MsgType.Control_Replicate,
+                               msg_id=next_msg_id()))
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self._net.recv()
+            except ConnectionError:
+                if self._stop.is_set():
+                    return
+                self._resubscribe()
+                continue
+            if msg is None:
+                return
+            self._detector.beat(_PRIMARY)
+            if msg.type == MsgType.Control_Wal_Record:
+                self._apply(msg)
+            elif msg.type == MsgType.Control_Reply_Replicate:
+                self._load_state(wire.decode(msg.data))
+            elif msg.type == MsgType.Control_Heartbeat:
+                pass
+            elif msg.type == MsgType.Reply_Error:
+                log.error("standby: primary refused replication: %s",
+                          wire.decode(msg.data) if msg.data else "?")
+
+    def _resubscribe(self) -> None:
+        """Connection loss: redial while the lease is still live. Success
+        triggers a fresh full-state transfer — records missed during the
+        blip are covered by the new snapshot."""
+        while (not self._stop.is_set()
+               and not self._detector.is_evicted(_PRIMARY)):
+            time.sleep(0.2)
+            # re-check after the sleep: _failover sets _stop BEFORE binding
+            # the service endpoint, so this cannot redial our own takeover
+            # server and subscribe a stream nobody will ever read
+            if self._stop.is_set() or self._detector.is_evicted(_PRIMARY):
+                return
+            try:
+                self._send_subscribe()  # _socket_for redials lazily
+                log.info("standby: replication stream re-established")
+                return
+            except OSError:
+                continue
+
+    def _run(self, fn):
+        """Apply on the dispatcher thread, serialized with any local
+        traffic (the standby's tables are normally quiet, but the seam is
+        the same one checkpoint restore uses)."""
+        server = self._zoo.server
+        if server is None or not hasattr(server, "run_serialized"):
+            return fn()
+        return server.run_serialized(fn)
+
+    def _load_state(self, payload: Any) -> None:
+        tables = payload.get("tables", {})
+        dedup = payload.get("dedup", [])
+
+        def run():
+            for table_id, blob in tables.items():
+                server_table = self._tables.get(int(table_id))
+                if server_table is None:
+                    log.error("standby: state transfer names unknown table "
+                              "%s — create tables in the primary's order",
+                              table_id)
+                    continue
+                data = bytes(np.ascontiguousarray(
+                    np.asarray(blob, dtype=np.uint8)))
+                server_table.load(mv_io.MemoryStream(data))
+
+        self._run(run)
+        self._seeds = [tuple(int(x) for x in entry) for entry in dedup]
+        self.synced.set()
+        log.info("standby: state transfer complete (%d table(s), %d dedup "
+                 "seed(s))", len(tables), len(self._seeds))
+
+    def _apply(self, msg: Message) -> None:
+        server_table = self._tables.get(msg.table_id)
+        if server_table is None:
+            log.error("standby: WAL record for unknown table %d dropped",
+                      msg.table_id)
+            return
+        request = wire.decode(msg.data)
+        self._run(lambda: server_table.process_add(request))
+        self._seeds.append((msg.req_id, msg.src, msg.msg_id))
+        self.records_applied += 1
+
+    # -- failover ------------------------------------------------------------
+    def _watch(self) -> None:
+        period = max(0.05, (self._detector.lease_seconds or 1.0) / 4.0)
+        while not self._stop.wait(period):
+            if _PRIMARY in self._detector.reap():
+                self._failover()
+                return
+
+    def _failover(self) -> None:
+        import multiverso_tpu as mv
+        log.info("standby: primary lease expired after %d replicated "
+                 "record(s) — taking over %s", self.records_applied,
+                 self._service_endpoint)
+        count("FAILOVERS")
+        self._stop.set()
+        self._net.finalize()
+        self._zoo._dedup_seeds = list(self._seeds)
+        # the dead primary's port can linger for a beat while the kernel
+        # tears the old socket down — retry the bind briefly
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                self.endpoint = mv.serve(self._service_endpoint)
+                break
+            except OSError as exc:
+                if time.monotonic() >= deadline:
+                    log.error("standby: could not bind %s after failover: "
+                              "%r", self._service_endpoint, exc)
+                    raise
+                time.sleep(0.2)
+        self.took_over.set()
+        log.info("standby: serving on %s — clients resume via their "
+                 "reconnect path", self.endpoint)

@@ -15,6 +15,7 @@ FIRES wins, non-firing matches still advance that rule's counter)::
     delay:type=Reply_Get,prob=0.5,seconds=0.2
     reorder:dst=0,after=4                 # hold a frame, release behind the next
     partition:src=1,dst=0                 # one-way: rank 1 can never reach rank 0
+    corrupt:type=Request_Add,every=6      # seeded bit-flip in the blob payload
 
 Predicates: ``src= dst= table=`` (ints), ``type=`` (MsgType name or int).
 Limiters: ``first=N`` (only the first N matches), ``after=N`` (skip the
@@ -36,9 +37,9 @@ from typing import Dict, List, Optional
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count
 from multiverso_tpu.runtime.message import Message, MsgType
-from multiverso_tpu.runtime.net import TcpNet
+from multiverso_tpu.runtime.net import _HEADER, TcpNet
 
-_ACTIONS = ("drop", "delay", "dup", "reorder", "partition")
+_ACTIONS = ("drop", "delay", "dup", "reorder", "partition", "corrupt")
 
 
 @dataclass
@@ -137,6 +138,13 @@ class FaultInjector:
                     return rule
         return None
 
+    def draw(self, n: int) -> int:
+        """Seeded integer in [0, n) — corruption offsets/bit picks come
+        from the same rng as the prob= coins, so a corrupt schedule
+        replays bit-for-bit."""
+        with self._lock:
+            return self._rng.randrange(n)
+
 
 class _Held:
     """A reorder-held frame: released behind the next frame to the same
@@ -175,15 +183,21 @@ class ChaosNet(TcpNet):
 
     # -- intercepted send paths ---------------------------------------------
     def _send(self, msg: Message, channel: int) -> int:
-        return self._apply(msg, lambda: super(ChaosNet, self)._send(
-            msg, channel), key=("rank", msg.dst))
+        sup = super(ChaosNet, self)
+        return self._apply(msg, lambda: sup._send(msg, channel),
+                           key=("rank", msg.dst),
+                           raw=lambda fr: sup._send_raw(msg.dst, fr),
+                           channel=channel)
 
     def send_via(self, conn, msg: Message, channel: int = 0) -> int:
-        return self._apply(msg, lambda: super(ChaosNet, self).send_via(
-            conn, msg, channel), key=("conn", id(conn)))
+        sup = super(ChaosNet, self)
+        return self._apply(msg, lambda: sup.send_via(conn, msg, channel),
+                           key=("conn", id(conn)),
+                           raw=lambda fr: sup._send_via_raw(conn, fr),
+                           channel=channel)
 
     # -- schedule application -----------------------------------------------
-    def _apply(self, msg: Message, send, key) -> int:
+    def _apply(self, msg: Message, send, key, raw, channel) -> int:
         self._release_held(key)
         rule = self._injector.fire(msg)
         if rule is None:
@@ -192,6 +206,22 @@ class ChaosNet(TcpNet):
             log.debug("chaos: %s frame %s->%s %s", rule.action, msg.src,
                       msg.dst, msg.type)
             return 0
+        if rule.action == "corrupt":
+            # seeded single-bit flip in the frame's blob section; the v3
+            # frame CRC detects it receiver-side and the frame is
+            # discarded — recovered by retransmit, exactly like a drop.
+            # (Blob-less frames — heartbeats — pass through untouched:
+            # header corruption would kill the connection, a different
+            # failure class already covered by the reconnect path.)
+            frame = bytearray(self._frame(msg, channel))
+            if len(frame) <= _HEADER.size:
+                return send()
+            pos = _HEADER.size + self._injector.draw(
+                len(frame) - _HEADER.size)
+            frame[pos] ^= 1 << self._injector.draw(8)
+            log.debug("chaos: corrupt frame %s->%s %s (byte %d)", msg.src,
+                      msg.dst, msg.type, pos)
+            return raw(bytes(frame))
         if rule.action == "dup":
             n = send()
             send()

@@ -55,6 +55,13 @@ class Stream:
     def flush(self) -> None:
         pass
 
+    def sync(self) -> None:
+        """Durability barrier: on return, everything written so far has
+        reached stable storage (fsync where the scheme has one). The WAL's
+        ``wal_sync=always`` policy rides this; schemes without a real
+        barrier degrade to flush()."""
+        self.flush()
+
     def close(self) -> None:
         pass
 
@@ -96,6 +103,11 @@ class LocalStream(Stream):
     def flush(self) -> None:
         if self._fp is not None:
             self._fp.flush()
+
+    def sync(self) -> None:
+        if self._fp is not None:
+            self._fp.flush()
+            os.fsync(self._fp.fileno())
 
     def close(self) -> None:
         if self._fp is not None:

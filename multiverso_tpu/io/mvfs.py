@@ -220,6 +220,15 @@ class MvfsServer:
             fp, _ = handles[req["handle"]]
             fp.write(payload)
             return {"written": len(payload)}, b""
+        if op == "sync":
+            # durability barrier for WAL appends through the scheme; note
+            # the temp file only commits (rename) on close, so an open
+            # handle's bytes are durable but not yet visible at the final
+            # name — see docs/fault_tolerance.md §7 on mvfs-backed WALs
+            fp, _ = handles[req["handle"]]
+            fp.flush()
+            os.fsync(fp.fileno())
+            return {}, b""
         if op == "close":
             fp, tmp = handles.pop(req["handle"])
             fp.close()
@@ -368,6 +377,10 @@ class MvfsStream(Stream):
 
     def good(self) -> bool:
         return self._handle is not None
+
+    def sync(self) -> None:
+        if self._handle is not None and self._writing:
+            self._conn.call({"op": "sync", "handle": self._handle})
 
     def close(self) -> None:
         if self._handle is not None:

@@ -39,6 +39,12 @@ class MsgType(enum.IntEnum):
     Control_Reply_Register = -34
     Control_Deregister = 35  # graceful client close frees its worker slot
     Control_Heartbeat = 36  # remote worker lease renewal (fault/detector.py)
+    # warm-standby replication (durable/standby.py): a standby subscribes
+    # with Control_Replicate, receives a quiesced full-state transfer in
+    # the reply, then tails the primary's WAL as Control_Wal_Record frames
+    Control_Replicate = 37
+    Control_Reply_Replicate = -37
+    Control_Wal_Record = 38
 
     @property
     def is_server_bound(self) -> bool:

@@ -328,6 +328,7 @@ class FollowerServer:
     def __init__(self, runtime: "MultihostRuntime") -> None:
         self._runtime = runtime
         self._tables: Dict[int, Any] = {}
+        self.wal = None  # followers never serve the wire; Server surface parity
         # the leader's server semantics, recomputed from the (identical)
         # flags — clients consult these capability bits
         self.gates_gets = (bool(config.get_flag("sync"))

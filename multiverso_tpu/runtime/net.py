@@ -28,22 +28,28 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from multiverso_tpu import log
+from multiverso_tpu.dashboard import count
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
 _MAGIC = 0x4D565450  # 'MVTP'
 # Wire version — the ONE place the frame layout is bumped. v2 grew the
-# req_id field (idempotent replay, fault/retry.py); both sides of every
-# deployment ship from this repo, so a mismatch is a config error and the
-# connection is dropped loudly rather than negotiated.
-_VERSION = 2
-# magic, version, channel, src, dst, type, table, msg_id, req_id, nblobs
-_HEADER = struct.Struct("<IBBiiiiqqi")
+# req_id field (idempotent replay, fault/retry.py); v3 grew payload_len +
+# a CRC32 over the blob section, so a corrupted frame is detected and
+# DISCARDED (the length keeps the stream in sync; retransmit + the dedup
+# window recover the frame) instead of desyncing on a garbled blob size.
+# Both sides of every deployment ship from this repo, so a mismatch is a
+# config error and the connection is dropped loudly rather than negotiated.
+_VERSION = 3
+# magic, version, channel, src, dst, type, table, msg_id, req_id, nblobs,
+# payload_len, crc32(payload)
+_HEADER = struct.Struct("<IBBiiiiqqiqI")
 _BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
 
 
@@ -212,31 +218,39 @@ class TcpNet:
         """Send over an explicit connection — the reply path for peers that
         never bound a listener (remote table clients): the server answers
         over the socket the request arrived on (``msg._conn``)."""
-        with self._conn_lock:
-            lock = self._sock_locks.setdefault(conn, threading.Lock())
-        frame = self._frame(msg, channel)
-        with lock:
-            conn.sendall(frame)
-        return len(frame)
+        return self._send_via_raw(conn, self._frame(msg, channel))
 
     # -- internals ----------------------------------------------------------
     @staticmethod
     def _frame(msg: Message, channel: int) -> bytes:
-        parts = [b""]  # placeholder for header
+        parts = []
         for arr in msg.data:
             head, payload = _pack_blob(np.asarray(arr))
             parts.append(head)
             parts.append(payload)
-        parts[0] = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src, msg.dst,
-                                int(msg.type), msg.table_id, msg.msg_id,
-                                msg.req_id, len(msg.data))
-        return b"".join(parts)
+        payload = b"".join(parts)
+        header = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src, msg.dst,
+                              int(msg.type), msg.table_id, msg.msg_id,
+                              msg.req_id, len(msg.data), len(payload),
+                              zlib.crc32(payload))
+        return header + payload
 
     def _send(self, msg: Message, channel: int) -> int:
-        sock = self._socket_for(msg.dst)
-        frame = self._frame(msg, channel)
-        with self._send_locks.setdefault(msg.dst, threading.Lock()):
+        return self._send_raw(msg.dst, self._frame(msg, channel))
+
+    def _send_raw(self, dst: int, frame: bytes) -> int:
+        """Framed-bytes send seam: ChaosNet's ``corrupt`` action flips bits
+        in an already-built frame and ships it through here."""
+        sock = self._socket_for(dst)
+        with self._send_locks.setdefault(dst, threading.Lock()):
             sock.sendall(frame)
+        return len(frame)
+
+    def _send_via_raw(self, conn: socket.socket, frame: bytes) -> int:
+        with self._conn_lock:
+            lock = self._sock_locks.setdefault(conn, threading.Lock())
+        with lock:
+            conn.sendall(frame)
         return len(frame)
 
     def _socket_for(self, rank: int) -> socket.socket:
@@ -286,7 +300,7 @@ class TcpNet:
             while self._active:
                 head = _read_exact(conn, _HEADER.size)
                 (magic, version, channel, src, dst, mtype, table_id, msg_id,
-                 req_id, nblobs) = _HEADER.unpack(head)
+                 req_id, nblobs, payload_len, crc) = _HEADER.unpack(head)
                 if magic != _MAGIC:
                     log.error("net: bad frame magic %x", magic)
                     self._drop_conn(conn, srcs_seen)
@@ -297,16 +311,29 @@ class TcpNet:
                     self._drop_conn(conn, srcs_seen)
                     return
                 srcs_seen.add(src)
+                # the header's payload_len keeps the stream in sync even
+                # when the payload is garbage: read it all, checksum, and
+                # only then parse blob structure out of it
+                payload = _read_exact(conn, payload_len) if payload_len \
+                    else b""
+                if zlib.crc32(payload) != crc:
+                    count("FRAME_CRC_REJECTS")
+                    log.error("net: CRC mismatch on %s frame from %d — "
+                              "frame discarded (retransmit recovers it)",
+                              MsgType(mtype), src)
+                    continue
+                off = 0
                 blobs = []
                 for _ in range(nblobs):
-                    bh = _read_exact(conn, _BLOB.size)
-                    ndim, dt, nbytes = _BLOB.unpack(bh)
-                    shape = struct.unpack(
-                        f"<{ndim}q", _read_exact(conn, 8 * ndim))
-                    payload = _read_exact(conn, nbytes)
+                    ndim, dt, nbytes = _BLOB.unpack_from(payload, off)
+                    off += _BLOB.size
+                    shape = struct.unpack_from(f"<{ndim}q", payload, off)
+                    off += 8 * ndim
+                    dtype = np.dtype(dt.decode().strip())
                     blobs.append(np.frombuffer(
-                        payload, dtype=np.dtype(dt.decode().strip())
-                    ).reshape(shape).copy())
+                        payload, dtype=dtype, count=nbytes // dtype.itemsize,
+                        offset=off).reshape(shape).copy())
+                    off += nbytes
                 msg = Message(src=src, dst=dst, type=MsgType(mtype),
                               table_id=table_id, msg_id=msg_id,
                               req_id=req_id, data=blobs)

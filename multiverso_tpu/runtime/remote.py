@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from multiverso_tpu import config, log
+from multiverso_tpu import io as mv_io
 from multiverso_tpu.dashboard import count
 from multiverso_tpu.fault.detector import LivenessDetector
 from multiverso_tpu.fault.inject import make_net
@@ -133,6 +134,12 @@ class RemoteServer:
         self._dedup: "OrderedDict[int, Any]" = OrderedDict()
         self._dedup_lock = threading.Lock()
         self._dedup_max = max(16, int(config.get_flag("dedup_window")))
+        # warm-standby replication subscribers (durable/standby.py):
+        # connections that receive every WAL record + periodic heartbeats
+        self._standbys: List[Any] = []
+        self._standby_lock = threading.Lock()
+        self._standby_hb: Optional[threading.Thread] = None
+        self._standby_hb_stop = threading.Event()
         self.liveness = LivenessDetector(
             float(config.get_flag("lease_seconds")))
         self.endpoint: Optional[str] = None
@@ -143,6 +150,10 @@ class RemoteServer:
         if self._zoo.server is not None:
             # the sync watchdog polls this to escalate stalls to evictions
             self._zoo.server.liveness = self.liveness
+            if self._zoo.server.wal is not None:
+                # replication fan-out: every durable append reaches the
+                # subscribed standbys over their replication connections
+                self._zoo.server.wal.add_observer(self._replicate_record)
         self._thread = threading.Thread(target=self._pump, daemon=True,
                                         name="mv-remote-serve")
         self._thread.start()
@@ -152,6 +163,10 @@ class RemoteServer:
         if (self._zoo.server is not None
                 and self._zoo.server.liveness is self.liveness):
             self._zoo.server.liveness = None
+        self._standby_hb_stop.set()
+        if self._standby_hb is not None:
+            self._standby_hb.join(timeout=10)
+            self._standby_hb = None
         self._net.finalize()
         if self._thread is not None:
             self._thread.join(timeout=10)
@@ -187,6 +202,115 @@ class RemoteServer:
             if req_id in self._dedup:
                 self._dedup[req_id] = reply
 
+    def seed_dedup(self, seeds) -> None:
+        """Rebuild the idempotent-replay window from recovered/replicated
+        WAL records — ``(req_id, worker, msg_id)`` triples in replay
+        order. A client retransmitting an Add that was logged before the
+        crash/failover gets a synthesized ACK instead of a second apply:
+        exactly-once survives the restart. Remote Add replies are
+        ACK-shaped (the client ignores the payload), so the synthesis is
+        faithful to what the dead server would have re-sent."""
+        with self._dedup_lock:
+            for req_id, worker, msg_id in list(seeds)[-self._dedup_max:]:
+                self._dedup[int(req_id)] = Message(
+                    src=0, dst=int(worker), type=MsgType.Reply_Add,
+                    msg_id=int(msg_id), req_id=int(req_id),
+                    data=wire.encode(None))
+            while len(self._dedup) > self._dedup_max:
+                self._dedup.popitem(last=False)
+
+    # -- warm-standby replication (durable/standby.py) -----------------------
+    def _replicate_record(self, req_id: int, worker: int, table_id: int,
+                          msg_id: int, blobs) -> None:
+        """WAL observer: forward one durable record to every subscribed
+        standby. Runs on the dispatcher thread right after the append, so
+        a record the primary ACKs was already written to each standby's
+        socket before the ACK frame — the kernel delivers it even if the
+        primary dies the next instant."""
+        with self._standby_lock:
+            conns = list(self._standbys)
+        for conn in conns:
+            msg = Message(src=worker, dst=-1,
+                          type=MsgType.Control_Wal_Record,
+                          table_id=table_id, msg_id=msg_id, req_id=req_id,
+                          data=list(blobs))
+            try:
+                self._net.send_via(conn, msg)
+            except OSError as exc:
+                log.error("remote: replication to a standby failed (%r); "
+                          "dropping the subscriber — it will resubscribe "
+                          "with a full state transfer", exc)
+                with self._standby_lock:
+                    if conn in self._standbys:
+                        self._standbys.remove(conn)
+
+    def _subscribe_standby(self, msg: Message) -> None:
+        """Handle Control_Replicate: quiesced full-state transfer (every
+        table + the Add half of the dedup window), then subscribe the
+        connection to the live record stream. The snapshot and the
+        subscription happen in ONE dispatcher-serialized block, so no add
+        can fall between them."""
+        wal = self._zoo.server.wal
+        if wal is None:
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Reply_Error,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode("replication needs durability: start the "
+                                 "primary with the wal_dir flag")))
+            return
+
+        def transfer():
+            tables = {}
+            for table_id, table in list(self._zoo.server._tables.items()):
+                stream = mv_io.MemoryStream()
+                table.store(stream)
+                tables[int(table_id)] = np.frombuffer(
+                    stream.getvalue(), dtype=np.uint8)
+            with self._dedup_lock:
+                dedup = [[m.req_id, m.dst, m.msg_id]
+                         for m in self._dedup.values()
+                         if isinstance(m, Message)
+                         and m.type == MsgType.Reply_Add]
+            with self._standby_lock:
+                self._standbys.append(msg._conn)
+            return tables, dedup
+
+        tables, dedup = self._zoo.server.run_serialized(transfer)
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Replicate,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=wire.encode({"tables": tables, "dedup": dedup})))
+        log.info("remote: standby subscribed (%d table(s), %d dedup "
+                 "seed(s) transferred)", len(tables), len(dedup))
+        self._ensure_standby_heartbeats()
+
+    def _ensure_standby_heartbeats(self) -> None:
+        """Primary→standby heartbeats: the standby's lease on the primary
+        must stay renewed while the WAL idles, or a quiet training lull
+        would look like primary death."""
+        if self._standby_hb is not None:
+            return
+        period = float(config.get_flag("heartbeat_seconds"))
+        if period <= 0:
+            return
+        self._standby_hb = threading.Thread(
+            target=self._standby_heartbeat_loop, args=(period,),
+            daemon=True, name="mv-remote-standby-hb")
+        self._standby_hb.start()
+
+    def _standby_heartbeat_loop(self, period: float) -> None:
+        beat = Message(src=0, dst=-1, type=MsgType.Control_Heartbeat)
+        while not self._standby_hb_stop.wait(period):
+            with self._standby_lock:
+                conns = list(self._standbys)
+            for conn in conns:
+                try:
+                    self._net.send_via(conn, beat)
+                except OSError:
+                    with self._standby_lock:
+                        if conn in self._standbys:
+                            self._standbys.remove(conn)
+
     # -- pump ---------------------------------------------------------------
     def _pump(self) -> None:
         compress = bool(config.get_flag("wire_compression"))
@@ -217,6 +341,9 @@ class RemoteServer:
         if msg.type == MsgType.Control_Deregister:
             self._deregister_client(msg)
             return
+        if msg.type == MsgType.Control_Replicate:
+            self._subscribe_standby(msg)
+            return
         if msg.type == MsgType.Server_Finish_Train:
             self._zoo.server.send(Message(
                 src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
@@ -229,9 +356,17 @@ class RemoteServer:
             return
         request = wire.decode(msg.data)
         completion = _NetCompletion(self, msg._conn, msg, compress)
-        self._zoo.server.send(Message(
+        forward = Message(
             src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
-            msg_id=msg.msg_id, data=[request, completion]))
+            msg_id=msg.msg_id, data=[request, completion])
+        if (msg.type == MsgType.Request_Add and msg.req_id
+                and self._zoo.server.wal is not None):
+            # raw wire blobs ride along for the dispatcher's write-ahead
+            # append (Server._wal_append) — logged before apply/ACK,
+            # replayed through wire.decode at recovery
+            forward._wal = (msg.req_id, msg.src, msg.table_id, msg.msg_id,
+                            msg.data)
+        self._zoo.server.send(forward)
 
     def _deregister_client(self, msg: Message) -> None:
         # Graceful close. Slot recycling is async-server only: the sync

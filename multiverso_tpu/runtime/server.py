@@ -115,6 +115,23 @@ class Server:
         # when no off-mesh clients exist. Only the sync watchdog acts on
         # it — async servers have no round gates a dead worker could hold.
         self.liveness = None
+        # Write-ahead log (durable/wal.py), attached by mv.serve() when
+        # the wal_dir flag is set; None = no durability. Wire Adds carry
+        # their raw blobs in msg._wal and are appended via _wal_append on
+        # this dispatcher thread before the add is applied/ACKed.
+        self.wal = None
+
+    def _wal_append(self, msg: Message) -> None:
+        """Append a wire Add's WAL entry (attached by the RemoteServer)
+        immediately before it is applied, so WAL order equals apply order
+        and recovery replay reproduces the table bit-for-bit. The entry is
+        popped so a deferred message re-dispatched by a drain loop appends
+        exactly once. Runs on the dispatcher thread — appends serialize
+        with applies for free."""
+        entry = getattr(msg, "_wal", None)
+        if entry is not None and self.wal is not None:
+            msg._wal = None
+            self.wal.append(*entry)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -203,6 +220,7 @@ class Server:
     def _process_add(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_ADD_MSG"):
             request, completion = msg.data
+            self._wal_append(msg)
             # process_add may return a fused-get payload (ArrayTable's
             # add+get sync path); plain adds return None as before
             completion.done(self._tables[msg.table_id].process_add(request))
@@ -253,6 +271,12 @@ class DeterministicServer(Server):
         if not 0 <= msg.src < self.num_workers:
             super()._process_add(msg)  # administrative: apply immediately
             return
+        # WAL entry at ENQUEUE (arrival order), matching the ACK-at-enqueue
+        # contract: recovery replays in arrival order, so exactly-once
+        # holds across a crash, but the (round, worker) apply order — and
+        # with it bitwise run-to-run reproducibility — does not survive a
+        # mid-training restart (docs/fault_tolerance.md §7).
+        self._wal_append(msg)
         self._add_queues[msg.table_id][msg.src].append(msg)
         msg.data[-1].done(None)  # accepted; applies in round order below
         self._drain_adds(msg.table_id)
@@ -452,6 +476,7 @@ class SyncServer(Server):
         # round-r Adds wait until every worker has finished its round-(r-1) Gets
         if self._min_gets(tid) >= round_ - 1:
             request, completion = msg.data
+            self._wal_append(msg)
             # forward the fused-sync reply (ArrayTable leaf mode) rather
             # than discarding it — the client would otherwise re-run the
             # whole merged-value split in a fallback get
@@ -510,6 +535,7 @@ class SyncServer(Server):
                 round_ = self._add_clock[table_id][worker] + 1
                 if self._min_gets(table_id) >= round_ - 1:
                     request, completion = msg.data
+                    self._wal_append(msg)
                     completion.done(
                         self._tables[table_id].process_add(request))
                     self._add_clock[table_id][worker] = round_
@@ -547,6 +573,7 @@ class SSPServer(SyncServer):
             super(SyncServer, self)._process_add(msg)
             return
         request, completion = msg.data
+        self._wal_append(msg)
         completion.done(self._tables[tid].process_add(request))
         self._add_clock[tid][worker] += 1
         self._drain(tid)

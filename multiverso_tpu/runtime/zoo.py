@@ -91,6 +91,9 @@ class Zoo:
         self._process_count = 1
         self._barrier: Optional[threading.Barrier] = None
         self._worker_tables: List[Any] = []
+        # dedup-window seeds from durable recovery / standby replication,
+        # consumed by the next mv.serve() (exactly-once across restarts)
+        self._dedup_seeds: Optional[List] = None
         self._agg_lock = threading.Lock()
         self._agg_slots: Dict[int, np.ndarray] = {}
         self._agg_result: Optional[np.ndarray] = None
@@ -172,6 +175,9 @@ class Zoo:
             self.remote_server.stop()
             self.remote_server = None
         if self.server is not None:
+            if getattr(self.server, "wal", None) is not None:
+                self.server.wal.close()
+                self.server.wal = None
             self.server.stop()
             self.server = None
         if self.multihost is not None:

@@ -1,0 +1,93 @@
+"""Child process serving DURABLE tables — the kill target for the
+crash-point-recovery and warm-standby-failover tests.
+
+Usage: python durable_primary_child.py <port> <wal_dir> [options]
+
+    --sync                      BSP server (ps_role=server either way)
+    --recover                   run mv.durable_recover before serving
+                                (the restarted-server role)
+    --crash-point=P --crash-at=N
+                                os._exit(9) on the N-th wire Add at point
+                                P: before_append (nothing logged),
+                                after_append (logged, apply/ACK never
+                                happen), after_ack (logged+applied+ACKed)
+
+Prints ``serving <endpoint> <table_id>`` once ready, then sleeps until
+killed (or until the armed crash fires)."""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import multiverso_tpu as mv  # noqa: E402
+
+
+def _arm_crash(point: str, at: int) -> None:
+    state = {"appends": 0, "acks": 0}
+    if point in ("before_append", "after_append"):
+        from multiverso_tpu.runtime.server import Server
+        orig = Server._wal_append
+
+        def hooked(self, msg):
+            if getattr(msg, "_wal", None) is None or self.wal is None:
+                return orig(self, msg)
+            state["appends"] += 1
+            if state["appends"] == at and point == "before_append":
+                os._exit(9)
+            orig(self, msg)
+            if state["appends"] == at and point == "after_append":
+                os._exit(9)
+
+        Server._wal_append = hooked
+    elif point == "after_ack":
+        from multiverso_tpu.runtime import remote
+        from multiverso_tpu.runtime.message import MsgType
+        orig_reply = remote._NetCompletion._reply
+
+        def hooked_reply(self, msg_type, payload):
+            orig_reply(self, msg_type, payload)
+            if self._template.type == MsgType.Request_Add:
+                state["acks"] += 1
+                if state["acks"] == at:
+                    os._exit(9)
+
+        remote._NetCompletion._reply = hooked_reply
+    else:
+        raise SystemExit(f"unknown crash point {point!r}")
+
+
+def main() -> int:
+    port, wal_dir = sys.argv[1], sys.argv[2]
+    opts = sys.argv[3:]
+    crash_point, crash_at = None, 0
+    for arg in opts:
+        if arg.startswith("--crash-point="):
+            crash_point = arg.split("=", 1)[1]
+        elif arg.startswith("--crash-at="):
+            crash_at = int(arg.split("=", 1)[1])
+    flags = dict(ps_role="server", remote_workers=2, wal_dir=wal_dir,
+                 heartbeat_seconds=0.2, lease_seconds=30.0)
+    if "--sync" in opts:
+        flags["sync"] = True
+    mv.init(**flags)
+    table = mv.create_table("array", 8, np.float32)
+    if "--recover" in opts:
+        mv.durable_recover([table])
+    if crash_point:
+        _arm_crash(crash_point, crash_at)
+    endpoint = mv.serve(f"127.0.0.1:{port}")
+    print(f"serving {endpoint} {table.table_id}", flush=True)
+    time.sleep(600)  # killed (or crashed) long before this
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

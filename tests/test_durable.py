@@ -1,0 +1,401 @@
+"""Durability subsystem: WAL append/replay, snapshot compaction,
+exactly-once crash-point recovery, corrupt-frame chaos, warm-standby
+failover (multiverso_tpu/durable/).
+
+The acceptance pair from the subsystem's charter:
+* a killed server loses ZERO acknowledged Adds and double-applies NONE
+  after recovery, whichever instant the crash hits (before the WAL
+  append / after the append but before the ACK / after the ACK);
+* a killed PRIMARY is replaced by a warm standby within the lease
+  window, and training completes with the final table exactly the
+  fault-free result.
+
+``make failover`` runs the child-process tests here; ``make chaos`` runs
+the in-process chaos/unit portion alongside tests/test_fault.py.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import checkpoint
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.durable import wal as dwal
+from multiverso_tpu.runtime.zoo import Zoo
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+_CHILD = os.path.join(os.path.dirname(__file__), "durable_primary_child.py")
+
+
+def _free_port() -> int:
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def _spawn_child(args):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(_CHILD)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen([sys.executable, _CHILD, *args],
+                            stdout=subprocess.PIPE, text=True, env=env)
+
+
+def _await_serving(child):
+    seen = []
+    while len(seen) < 50:  # log INFO lines precede the ready marker
+        line = child.stdout.readline()
+        if not line:
+            break
+        line = line.strip()
+        seen.append(line)
+        if line.startswith("serving "):
+            _, endpoint, table_id = line.split()
+            return endpoint, int(table_id)
+    raise AssertionError(f"child never reported serving: {seen}")
+
+
+# -- units: record codec, torn tails, manifest --------------------------------
+
+def test_wal_record_codec_roundtrip_and_torn_tail():
+    blobs = [np.arange(6, dtype=np.float32).reshape(2, 3),
+             np.array([7, 8, 9], dtype=np.int64)]
+    rec1 = dwal._encode_record(11, 1, 101, blobs)
+    rec2 = dwal._encode_record(12, 2, 102, [np.float32([1.5])])
+    head = dwal._SEG_HDR.pack(dwal._SEG_MAGIC, dwal._SEG_VERSION, 5, 0)
+
+    records, valid, clean = dwal._read_segment(head + rec1 + rec2, "seg")
+    assert clean and len(records) == 2
+    assert records[0].req_id == 11 and records[0].worker == 1
+    assert records[0].msg_id == 101 and records[0].table_id == 5
+    np.testing.assert_array_equal(records[0].blobs[0], blobs[0])
+    np.testing.assert_array_equal(records[0].blobs[1], blobs[1])
+
+    # torn tail: rec2 cut mid-body -> rec1 survives, tear reported
+    records, valid, clean = dwal._read_segment(
+        head + rec1 + rec2[:len(rec2) - 3], "seg")
+    assert not clean and len(records) == 1
+    assert valid == len(head) + len(rec1)
+
+    # bit-flip in rec1's body: CRC stops replay at the first bad record
+    corrupt = bytearray(head + rec1 + rec2)
+    corrupt[len(head) + dwal._REC_HDR.size + 4] ^= 0x40
+    records, valid, clean = dwal._read_segment(bytes(corrupt), "seg")
+    assert not clean and len(records) == 0 and valid == len(head)
+
+    # unreadable segment header
+    records, _, _ = dwal._read_segment(b"JUNKJUNKJUNKJUNK", "seg")
+    assert records is None
+
+
+def test_manifest_roundtrip(tmp_path):
+    root = str(tmp_path)
+    assert dwal.read_manifest(root) == {"generation": -1, "first_segment": 0}
+    dwal._write_manifest(root, 3, 7)
+    assert dwal.read_manifest(root) == {"generation": 3, "first_segment": 7}
+    assert not os.path.exists(os.path.join(root, "MANIFEST.tmp"))
+
+
+def test_dashboard_render_text_dump():
+    from multiverso_tpu.dashboard import count, monitor
+    count("WAL_APPENDS", 4)
+    with monitor("SERVER_PROCESS_ADD_MSG"):
+        pass
+    text = Dashboard.render()
+    assert "WAL_APPENDS" in text and "4" in text
+    assert "SERVER_PROCESS_ADD_MSG" in text
+    assert "counter" in text and "section" in text
+
+
+# -- in-process WAL: append -> recover, compaction, truncation ----------------
+
+def _wipe(table):
+    """Zero a table in place (plays a fresh process's empty state)."""
+    with Zoo.instance().admin():
+        table.add(-np.asarray(table.get(), np.float32))
+        np.testing.assert_array_equal(np.asarray(table.get()),
+                                      np.zeros_like(np.asarray(table.get())))
+
+
+def test_wal_append_then_recover_restores_state_and_seeds(tmp_path):
+    root = str(tmp_path / "d")
+    mv.set_flag("wal_dir", root)
+    mv.init(remote_workers=1)
+    table = mv.create_table("array", 8, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    deltas = [np.full(8, float(2 ** k), np.float32) for k in range(4)]
+    for d in deltas:
+        rt.add(d)
+    client.close()
+    mv.stop_serving()
+    assert Dashboard.counter_value("WAL_APPENDS") == 4
+
+    _wipe(table)
+    result = mv.durable_recover([table])
+    assert result.records_replayed == 4 and result.tables_restored == 0
+    assert len(result.seeds) == 4
+    assert all(req and msg_id for req, _w, msg_id in result.seeds)
+    with Zoo.instance().admin():
+        np.testing.assert_array_equal(np.asarray(table.get()),
+                                      np.full(8, 15.0, np.float32))
+    # the seeds are staged for the next serve()'s dedup window
+    assert Zoo.instance()._dedup_seeds == result.seeds
+    mv.serve("127.0.0.1:0")
+    rs = Zoo.instance().remote_server
+    assert set(s[0] for s in result.seeds) <= set(rs._dedup)
+    mv.shutdown()
+
+
+def test_snapshot_compaction_rotates_and_retires(tmp_path):
+    root = str(tmp_path / "d")
+    mv.set_flag("wal_dir", root)
+    mv.init(remote_workers=1)
+    table = mv.create_table("array", 8, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    rt.add(np.full(8, 1.0, np.float32))
+    rt.add(np.full(8, 2.0, np.float32))
+
+    driver = checkpoint.CheckpointDriver([table], root, wal=mv.wal_writer())
+    driver.snapshot()
+    manifest = dwal.read_manifest(root)
+    assert manifest["generation"] == 0 and manifest["first_segment"] == 1
+    # segment 0 (pre-snapshot) is retired; generation 0 holds the snapshot
+    names = os.listdir(os.path.join(root, "wal"))
+    assert not any(n.startswith("seg00000000.") for n in names)
+    assert os.path.exists(os.path.join(root, "gen_0", "table_0.mvckpt"))
+    assert Dashboard.counter_value("SNAPSHOT_COMPACTIONS") == 1
+
+    rt.add(np.full(8, 4.0, np.float32))  # lands in segment 1
+    driver.snapshot()  # generation 1; segment 1 retired, gen_0 removed
+    assert dwal.read_manifest(root) == {"generation": 1, "first_segment": 2}
+    assert not os.path.exists(os.path.join(root, "gen_0", "table_0.mvckpt"))
+
+    rt.add(np.full(8, 8.0, np.float32))  # post-snapshot tail in segment 2
+    client.close()
+    mv.stop_serving()
+    _wipe(table)
+    result = mv.durable_recover([table])
+    assert result.tables_restored == 1 and result.records_replayed == 1
+    with Zoo.instance().admin():
+        np.testing.assert_array_equal(np.asarray(table.get()),
+                                      np.full(8, 15.0, np.float32))
+    mv.shutdown()
+
+
+def test_recover_truncates_torn_tail(tmp_path):
+    root = str(tmp_path / "d")
+    mv.set_flag("wal_dir", root)
+    mv.init(remote_workers=1)
+    table = mv.create_table("array", 8, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    rt.add(np.full(8, 3.0, np.float32))
+    rt.add(np.full(8, 4.0, np.float32))
+    client.close()
+    mv.stop_serving()
+
+    seg = os.path.join(root, "wal", "seg00000000.t0.mvwal")
+    good_size = os.path.getsize(seg)
+    with open(seg, "ab") as fp:  # a half-written record (crash tail)
+        fp.write(b"\x99" * 11)
+    _wipe(table)
+    result = mv.durable_recover([table])
+    assert result.records_replayed == 2
+    assert result.segments_truncated == 1
+    assert Dashboard.counter_value("WAL_TRUNCATED_TAIL") == 1
+    assert os.path.getsize(seg) == good_size  # tail physically cut
+    with Zoo.instance().admin():
+        np.testing.assert_array_equal(np.asarray(table.get()),
+                                      np.full(8, 7.0, np.float32))
+    mv.shutdown()
+
+
+def test_store_table_is_atomic(tmp_path, mv_env):
+    table = mv.create_table("array", 4, np.float32)
+    with Zoo.instance().admin():
+        table.add(np.full(4, 5.0, np.float32))
+    path = str(tmp_path / "t.mvckpt")
+    checkpoint.store_table(table, path)
+    assert os.path.exists(path)
+    # no temp sibling survives a successful store
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp-" in n] == []
+    # a stale temp file (crash leftover) never disturbs a restore
+    with open(path + f".tmp-{os.getpid()}", "wb") as fp:
+        fp.write(b"MVTC")  # truncated: the classic mid-write corpse
+    _wipe(table)
+    checkpoint.load_table(table, path)
+    with Zoo.instance().admin():
+        np.testing.assert_array_equal(np.asarray(table.get()),
+                                      np.full(4, 5.0, np.float32))
+
+
+# -- corrupt-frame chaos: bit-flips recovered via CRC + retransmit ------------
+
+def _push_deltas_under(spec):
+    if spec:
+        mv.set_flag("fault_spec", spec)
+        mv.set_flag("fault_seed", SEED)
+    mv.set_flag("request_retry_seconds", 0.3)
+    mv.init(remote_workers=1)
+    table = mv.create_table("array", 16, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    rng = np.random.default_rng(0)
+    deltas = rng.integers(-4, 5, size=(24, 16)).astype(np.float32)
+    handles = [rt.add_async(d) for d in deltas]
+    for h in handles:
+        rt.wait(h)
+    final = np.asarray(rt.get(), np.float32)
+    client.close()
+    mv.shutdown()
+    return final
+
+
+def test_chaos_corrupt_frames_finish_bit_for_bit():
+    """Seeded bit-flips in Add and reply payloads: the v3 frame CRC
+    rejects each corrupt frame, retransmit + dedup recover it, and the
+    final table is bit-for-bit the fault-free result."""
+    plain = _push_deltas_under("")
+    chaos = _push_deltas_under(
+        "corrupt:type=Request_Add,every=3;corrupt:type=Reply_Add,every=4")
+    np.testing.assert_array_equal(chaos, plain)
+    assert Dashboard.counter_value("FRAME_CRC_REJECTS") > 0
+    assert Dashboard.counter_value("FAULT_INJECTED_CORRUPT") > 0
+    assert Dashboard.counter_value("CLIENT_RETRIES") > 0
+
+
+# -- crash-point recovery: kill -9 at P, restart, exactly-once ----------------
+
+@pytest.mark.parametrize("point", ["before_append", "after_append",
+                                   "after_ack"])
+def test_crash_point_recovery_exactly_once(point, tmp_path):
+    """Kill the serving process at instant P of the 3rd Add, restart it
+    from the same WAL, and finish: zero acknowledged Adds lost, zero
+    double-applied (the dedup window is rebuilt from the WAL, so the
+    client's retransmit of a logged-but-unACKed Add is swallowed)."""
+    port = _free_port()
+    root = str(tmp_path / "d")
+    child = _spawn_child([str(port), root, f"--crash-point={point}",
+                          "--crash-at=3"])
+    child2 = None
+    try:
+        endpoint, table_id = _await_serving(child)
+        mv.set_flag("request_retry_seconds", 0.5)
+        mv.set_flag("reconnect_deadline_seconds", 90.0)
+        mv.set_flag("retry_base_seconds", 0.1)
+        mv.set_flag("heartbeat_seconds", 0.5)
+        client = mv.remote_connect(endpoint)
+        rt = client.table(table_id)
+        deltas = [np.full(8, float(2 ** k), np.float32) for k in range(5)]
+        rt.add(deltas[0])
+        rt.add(deltas[1])
+        handle = rt.add_async(deltas[2])  # the 3rd Add triggers the crash
+        child.wait(timeout=60)
+        assert child.returncode == 9
+        child2 = _spawn_child([str(port), root, "--recover"])
+        _await_serving(child2)
+        rt.wait(handle)  # settles via reconnect-resume (+ dedup re-reply)
+        rt.add(deltas[3])
+        rt.add(deltas[4])
+        final = np.asarray(rt.get(), np.float32)
+        np.testing.assert_array_equal(final, np.full(8, 31.0, np.float32))
+        client.close()
+    finally:
+        for proc in (child, child2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
+# -- warm-standby failover ----------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["async", "bsp"])
+def test_warm_standby_failover_training_completes(mode, tmp_path):
+    """kill -9 of the primary mid-training: the standby takes over the
+    service endpoint within the lease window and training completes with
+    the final table exactly the fault-free result (integer-valued float32
+    deltas make the sums exact, so apply-order changes cannot blur the
+    bit-for-bit comparison)."""
+    port = _free_port()
+    args = [str(port), str(tmp_path / "primary")]
+    if mode == "bsp":
+        args.append("--sync")
+    child = _spawn_child(args)
+    try:
+        endpoint, table_id = _await_serving(child)
+        flags = dict(ps_role="server", remote_workers=2,
+                     wal_dir=str(tmp_path / "standby"),
+                     request_retry_seconds=0.5,
+                     reconnect_deadline_seconds=90.0,
+                     retry_base_seconds=0.1, heartbeat_seconds=0.3)
+        if mode == "bsp":
+            flags["sync"] = True
+        mv.init(**flags)
+        mv.create_table("array", 8, np.float32)
+        standby = mv.warm_standby(endpoint, f"127.0.0.1:{port}",
+                                  lease_seconds=2.0)
+        assert standby.synced.wait(30), "state transfer never completed"
+
+        n_workers = 2 if mode == "bsp" else 1
+        rounds = 8
+        rng = np.random.default_rng(SEED)
+        deltas = rng.integers(-3, 4,
+                              size=(n_workers, rounds, 8)).astype(np.float32)
+        half_done = threading.Barrier(n_workers + 1)
+        results, errors = {}, []
+
+        def trainer(idx):
+            try:
+                client = mv.remote_connect(endpoint)
+                rt = client.table(table_id)
+                for i in range(rounds):
+                    rt.add(deltas[idx, i])
+                    if mode == "bsp":
+                        rt.get()
+                    if i == 2:
+                        half_done.wait(timeout=60)
+                rt.finish_train()
+                results[idx] = np.asarray(rt.get(), np.float32)
+                client.close()
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=trainer, args=(i,))
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        half_done.wait(timeout=60)  # 3 rounds acked by the primary
+        child.kill()  # SIGKILL: no goodbye of any kind
+        child.wait(timeout=30)
+        assert standby.took_over.wait(60), "standby never took over"
+        for t in threads:
+            t.join(timeout=120)
+        for t in threads:
+            assert not t.is_alive(), f"{mode} trainer wedged across failover"
+        assert not errors, errors
+
+        expected = deltas.sum(axis=(0, 1))
+        for idx, final in results.items():
+            np.testing.assert_array_equal(final, expected,
+                                          err_msg=f"trainer {idx}")
+        assert standby.records_applied > 0
+        assert Dashboard.counter_value("FAILOVERS") >= 1
+        assert Dashboard.counter_value("CLIENT_RECONNECTS") >= n_workers
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)

@@ -117,8 +117,11 @@ def test_dashboard_counters():
 
 def _push_deltas(fault_spec):
     """One full remote session pushing a fixed delta sequence; returns
-    (final table bytes, number of server-side process_add calls)."""
+    (final table bytes, number of server-side process_add calls).
+    CHAOS_EXTRA_SPEC (CI matrix) appends rules to every non-empty
+    schedule — e.g. a corrupt-mode run layering bit-flips on top."""
     if fault_spec:
+        fault_spec += os.environ.get("CHAOS_EXTRA_SPEC", "")
         mv.set_flag("fault_spec", fault_spec)
         mv.set_flag("fault_seed", SEED)
     mv.set_flag("request_retry_seconds", 0.3)
