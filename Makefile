@@ -6,9 +6,13 @@
 #   make check          native build + tests + multi-chip dryrun + bench
 #   make native         just the C++ layer (libmultiverso_tpu.so + C client)
 #   make test           just the suite (8-device virtual CPU mesh)
-#   make chaos          fault-injection + durability suites, fixed seed
-#                       (CHAOS_EXTRA_SPEC appends rules, e.g. corrupt mode)
+#   make chaos          fault-injection + durability + telemetry suites,
+#                       fixed seed (CHAOS_EXTRA_SPEC appends rules, e.g.
+#                       corrupt mode; MV_CHAOS_ARTIFACT_DIR collects
+#                       flight-recorder dumps + metrics JSONL for upload)
 #   make failover       crash-point recovery + warm-standby failover smoke
+#   make metrics-smoke  short remote-training session; assert the metrics
+#                       JSONL parses and key latency histograms are non-empty
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
 
@@ -16,7 +20,7 @@ PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos failover native test dryrun bench clean
+.PHONY: check chaos failover metrics-smoke native test dryrun bench clean
 
 check: native test dryrun bench
 
@@ -30,9 +34,12 @@ test: native
 
 chaos:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
-		tests/test_fault.py tests/test_durable.py -q \
+		tests/test_fault.py tests/test_durable.py tests/test_obs.py -q \
 		-k "not crash_point and not failover" \
 		-p no:cacheprovider -p no:randomly
+
+metrics-smoke:
+	$(CPU_ENV) $(PYTHON) tests/metrics_smoke.py
 
 failover:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \

@@ -31,6 +31,16 @@ def _fetch(x):
     return np.asarray(x)
 
 
+def load_metrics(path):
+    """Ingest a MetricsLogger JSONL stream (the ``metrics_path`` flag):
+    one dashboard snapshot dict per line — monitors, counters, gauges,
+    histograms as bucket arrays (rebuild with ``obs.metrics.Histogram.
+    from_dict`` for quantiles). This is the bench-side half of the format
+    contract ``make metrics-smoke`` asserts."""
+    from multiverso_tpu.obs.logger import load_metrics as _load
+    return _load(path)
+
+
 def _tpu_reps(tpu_reps, cpu_reps, sleep_s=1.5):
     """Repeat counter for burst-robust sections: more reps on the shared
     tunneled TPU, with a spacing sleep between them so seconds-scale load
@@ -201,12 +211,24 @@ def bench_ps_word2vec(vocab=100_000, dim=128, block_tokens=8192, n_blocks=4,
         k2 = max(16 // group, 8)
         per_block = run(k2) / (k2 * group)
         stats = trainer.last_block_stats
+        # dashboard snapshot alongside the throughput figure: the request
+        # path's latency DISTRIBUTION (obs/ telemetry — the monitor
+        # sections double as log-bucketed histograms), so a p99
+        # regression is visible even when the mean throughput holds
+        from multiverso_tpu.dashboard import Dashboard
+        add_hist = Dashboard.histogram("SERVER_PROCESS_ADD_MSG")
+        get_hist = Dashboard.histogram("SERVER_PROCESS_GET_MSG")
         return {
             "ps_words_per_sec": round(block_tokens / per_block, 1),
             "ps_block_tokens": block_tokens,
             "ps_block_group": group,
             "ps_rows_pulled_per_submission": (stats["in_rows"]
                                               + stats["out_rows"]),
+            "ps_add_p50_us": round(add_hist.p50 * 1e6, 1),
+            "ps_add_p95_us": round(add_hist.p95 * 1e6, 1),
+            "ps_add_p99_us": round(add_hist.p99 * 1e6, 1),
+            "ps_get_p99_us": round(get_hist.p99 * 1e6, 1),
+            "ps_requests_observed": add_hist.count + get_hist.count,
         }
     finally:
         mv.shutdown()

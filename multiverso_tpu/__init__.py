@@ -51,7 +51,30 @@ def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
     remaining = Zoo.instance().start(argv)
     _configure_native_allocator()
     _configure_profiling()
+    _start_metrics_logger()
     return remaining
+
+
+_metrics_logger = None
+
+
+def _start_metrics_logger() -> None:
+    """Start the periodic JSONL snapshot thread when the ``metrics_path``
+    flag is set (obs/logger.py); idempotent across repeated init()."""
+    global _metrics_logger
+    path = str(get_flag("metrics_path"))
+    if not path or _metrics_logger is not None:
+        return
+    from multiverso_tpu.obs.logger import MetricsLogger
+    _metrics_logger = MetricsLogger(
+        path, float(get_flag("metrics_interval_seconds")))
+
+
+def _stop_metrics_logger() -> None:
+    global _metrics_logger
+    if _metrics_logger is not None:
+        _metrics_logger.close()  # flushes a final snapshot
+        _metrics_logger = None
 
 
 def _configure_profiling() -> None:
@@ -107,6 +130,7 @@ def _configure_native_allocator() -> None:
 def shutdown(finalize_net: bool = True) -> None:
     Zoo.instance().stop(finalize_net)
     _stop_profiling()
+    _stop_metrics_logger()
 
 
 def barrier() -> None:
@@ -237,6 +261,16 @@ def remote_connect(endpoint: str, timeout: float = 30.0):
     ``.table(table_id)`` / ``.tables()`` give worker-table proxies."""
     from multiverso_tpu.runtime.remote import RemoteClient
     return RemoteClient(endpoint, timeout=timeout)
+
+
+def stats(endpoint: str, timeout: float = 10.0):
+    """Live stats RPC: pull a (possibly remote) serving process's full
+    dashboard — monitors, counters, gauges, and latency histograms with
+    caller-side p50/p95/p99 — without taking a worker slot. Returns a
+    :class:`~multiverso_tpu.obs.metrics.StatsSnapshot`; metric catalog in
+    ``docs/observability.md``."""
+    from multiverso_tpu.runtime.remote import fetch_stats
+    return fetch_stats(endpoint, timeout=timeout)
 
 
 def stop_serving() -> None:

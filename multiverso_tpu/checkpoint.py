@@ -18,12 +18,14 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
 
 from multiverso_tpu import io as mv_io
 from multiverso_tpu import log
+from multiverso_tpu.dashboard import observe
 
 _MAGIC = b"MVTC"
 
@@ -124,19 +126,25 @@ def store_table(table, address: str) -> None:
     ``restore_tables`` would hit as a fatal bad-magic error, defeating
     restart recovery)."""
     _require_leader("snapshot")
+    t0 = time.perf_counter()
     server = getattr(table, "_server_table", table)
     fs = mv_io.fs_for(address)
     tmp = f"{address}.tmp-{os.getpid()}"
     with mv_io.get_stream(tmp, "w") as stream:
         _run_serialized(lambda: server.store(stream))
     fs.replace(tmp, address)
+    # per-table store cost (device->host read + stream write + rename):
+    # the tail of this distribution is how long snapshots stall applies
+    observe("CHECKPOINT_STORE_SECONDS", time.perf_counter() - t0)
 
 
 def load_table(table, address: str) -> None:
     _require_leader("restore")
+    t0 = time.perf_counter()
     server = getattr(table, "_server_table", table)
     with mv_io.get_stream(address, "r") as stream:
         _run_serialized(lambda: server.load(stream))
+    observe("CHECKPOINT_RESTORE_SECONDS", time.perf_counter() - t0)
 
 
 def restore_tables(tables: List, directory: str) -> int:

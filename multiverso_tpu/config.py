@@ -233,6 +233,25 @@ define_string("wal_dir", "",
               "before it is ACKed; restart recovery = mv.durable_recover() "
               "(snapshot + WAL replay + dedup-window rebuild), compaction "
               "= CheckpointDriver(..., wal=mv.wal_writer()). Empty disables")
+# Telemetry subsystem (multiverso_tpu/obs/): latency histograms, gauges,
+# per-request tracing, flight recorder, metrics JSONL, stats RPC
+# (docs/observability.md).
+define_string("metrics_path", "",
+              "append periodic JSONL dashboard snapshots (monitors, "
+              "counters, gauges, histograms as bucket arrays) to this file "
+              "— the format bench.py's load_metrics ingests. Empty disables "
+              "the MetricsLogger thread")
+define_double("metrics_interval_seconds", 10.0,
+              "seconds between metrics_path snapshot lines")
+define_string("flight_recorder_path", "",
+              "append flight-recorder dumps (event + dashboard snapshot + "
+              "the last flight_recorder_traces per-request hop traces, one "
+              "JSON object per line) to this file on worker eviction, "
+              "standby failover, frame CRC reject, or a client failing all "
+              "pending requests. Empty disables dumping")
+define_int("flight_recorder_traces", 256,
+           "how many recent request traces each flight-recorder dump "
+           "includes (the in-memory trace ring holds at least this many)")
 define_string("wal_sync", "batch",
               "WAL durability barrier per append: none (buffered — the "
               "tail can be lost even to a process crash), batch (flush to "

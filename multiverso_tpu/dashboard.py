@@ -7,11 +7,18 @@ global ``Dashboard::Watch/Display`` (``include/multiverso/dashboard.h:16-75``,
 
 TPU-era additions: monitors double as ``jax.profiler.TraceAnnotation`` scopes
 when profiling is enabled, so named sections show up in TPU traces; the timer
-is a context manager / decorator instead of macro pairs.
+is a context manager / decorator instead of macro pairs. The registry also
+holds the telemetry subsystem's units (``multiverso_tpu/obs/``): monotonic
+``Counter``\\ s, log-bucketed ``Histogram``\\ s (every ``monitor`` section
+records its duration distribution, not just the average), and point-in-time
+``Gauge``\\ s. ``snapshot()`` serializes the whole registry for the stats
+RPC / metrics JSONL; ``render(format="prom")`` emits Prometheus text
+exposition. Metric catalog: ``docs/observability.md``.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, Iterator, Optional
@@ -25,26 +32,36 @@ except Exception:  # pragma: no cover
 
 
 class Monitor:
-    """count / total-elapse / average for one named code section."""
+    """count / total-elapse / average for one named code section.
+
+    The in-progress start time is THREAD-LOCAL: two threads timing the
+    same named section concurrently each measure their own span (a single
+    shared slot would let thread B's ``begin`` overwrite thread A's,
+    corrupting both durations — the historical bug)."""
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._count = 0
         self._elapse = 0.0  # seconds
-        self._begin: Optional[float] = None
+        self._tls = threading.local()  # per-thread in-progress start time
         self._lock = threading.Lock()
 
     def begin(self) -> None:
-        self._begin = time.perf_counter()
+        self._tls.begin = time.perf_counter()
 
     def end(self) -> None:
-        if self._begin is None:
+        begin = getattr(self._tls, "begin", None)
+        if begin is None:
             return
-        dt = time.perf_counter() - self._begin
-        self._begin = None
+        self._tls.begin = None
+        self.observe(time.perf_counter() - begin)
+
+    def observe(self, seconds: float) -> None:
+        """Record one completed span (the begin/end pair fused — what the
+        ``monitor`` context manager calls with its own local clock)."""
         with self._lock:
             self._count += 1
-            self._elapse += dt
+            self._elapse += seconds
 
     @property
     def count(self) -> int:
@@ -62,7 +79,7 @@ class Monitor:
         with self._lock:
             self._count = 0
             self._elapse = 0.0
-            self._begin = None
+            self._tls = threading.local()
 
     def __repr__(self) -> str:
         return (f"Monitor({self.name}: count={self.count}, "
@@ -98,11 +115,19 @@ class Counter:
         return f"Counter({self.name}: {self.value})"
 
 
+def _prom_name(name: str, suffix: str = "") -> str:
+    base = re.sub(r"[^a-zA-Z0-9_]", "_", name).lower().strip("_")
+    return f"mvtpu_{base}{suffix}"
+
+
 class Dashboard:
-    """Global registry of monitors (reference: ``Dashboard::Watch/Display``)."""
+    """Global registry of monitors (reference: ``Dashboard::Watch/Display``)
+    plus the telemetry units: counters, histograms, gauges."""
 
     _monitors: Dict[str, Monitor] = {}
     _counters: Dict[str, Counter] = {}
+    _histograms: Dict[str, "object"] = {}  # name -> obs.metrics.Histogram
+    _gauges: Dict[str, "object"] = {}      # name -> obs.metrics.Gauge
     _lock = threading.Lock()
     profile_annotations: bool = False
 
@@ -135,14 +160,73 @@ class Dashboard:
         return ctr.value if ctr is not None else 0
 
     @classmethod
-    def render(cls) -> str:
-        """Operator-facing text dump — aligned monitor/counter tables an
-        operator can read off a log or a debug endpoint without touching
-        the Python API (returned, never printed; ``display()`` keeps the
-        reference's print-and-return contract)."""
+    def histogram(cls, name: str):
+        """Log-bucketed latency histogram (obs/metrics.py); created on
+        first use like monitors/counters."""
+        with cls._lock:
+            hist = cls._histograms.get(name)
+            if hist is None:
+                # lazy import: dashboard is imported by everything, obs
+                # only by what uses it — keeps the import graph acyclic
+                from multiverso_tpu.obs.metrics import Histogram
+                hist = cls._histograms[name] = Histogram(name)
+            return hist
+
+    @classmethod
+    def gauge(cls, name: str):
+        with cls._lock:
+            g = cls._gauges.get(name)
+            if g is None:
+                from multiverso_tpu.obs.metrics import Gauge
+                g = cls._gauges[name] = Gauge(name)
+            return g
+
+    @classmethod
+    def gauge_value(cls, name: str) -> float:
+        with cls._lock:
+            g = cls._gauges.get(name)
+        return g.value if g is not None else 0.0
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        """The whole registry as plain JSON-serializable data — the stats
+        RPC payload, the metrics JSONL line, and the flight-recorder
+        snapshot all share this one format."""
         with cls._lock:
             monitors = list(cls._monitors.values())
             counters = list(cls._counters.values())
+            histograms = list(cls._histograms.values())
+            gauges = list(cls._gauges.values())
+        return {
+            "monitors": {m.name: {"count": m.count,
+                                  "elapse_ms": m.elapse_ms,
+                                  "average_ms": m.average_ms}
+                         for m in monitors},
+            "counters": {c.name: c.value for c in counters},
+            "gauges": {g.name: g.value for g in gauges},
+            "histograms": {h.name: h.to_dict() for h in histograms},
+        }
+
+    @classmethod
+    def render(cls, format: str = "text") -> str:
+        """Operator-facing dump (returned, never printed; ``display()``
+        keeps the reference's print-and-return contract).
+
+        ``format="text"``: aligned monitor/counter/gauge/histogram tables
+        an operator can read off a log or a debug endpoint.
+        ``format="prom"``: Prometheus text exposition (counters/gauges/
+        histograms with cumulative ``_bucket{le=...}`` rows) for scrape
+        endpoints and pushgateways."""
+        if format == "prom":
+            return cls._render_prom()
+        if format != "text":
+            raise ValueError(f"render: unknown format {format!r} "
+                             "(want 'text' or 'prom')")
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+            counters = list(cls._counters.values())
+            histograms = list(cls._histograms.values())
+            gauges = list(cls._gauges.values())
         lines = ["== dashboard =="]
         if monitors:
             lines.append(f"{'section':<36} {'count':>10} {'total_ms':>12} "
@@ -154,9 +238,54 @@ class Dashboard:
             lines.append(f"{'counter':<36} {'value':>10}")
             for c in counters:
                 lines.append(f"{c.name:<36} {c.value:>10}")
-        if not monitors and not counters:
+        if gauges:
+            lines.append(f"{'gauge':<36} {'value':>10}")
+            for g in gauges:
+                lines.append(f"{g.name:<36} {g.value:>10g}")
+        if histograms:
+            lines.append(f"{'histogram':<36} {'count':>8} {'p50_ms':>10} "
+                         f"{'p95_ms':>10} {'p99_ms':>10} {'max_ms':>10}")
+            for h in histograms:
+                lines.append(f"{h.name:<36} {h.count:>8} "
+                             f"{h.p50 * 1e3:>10.3f} {h.p95 * 1e3:>10.3f} "
+                             f"{h.p99 * 1e3:>10.3f} {h.max * 1e3:>10.3f}")
+        if not (monitors or counters or gauges or histograms):
             lines.append("(no monitors or counters recorded)")
         return "\n".join(lines)
+
+    @classmethod
+    def _render_prom(cls) -> str:
+        with cls._lock:
+            monitors = list(cls._monitors.values())
+            counters = list(cls._counters.values())
+            histograms = list(cls._histograms.values())
+            gauges = list(cls._gauges.values())
+        lines = []
+        for c in counters:
+            n = _prom_name(c.name)
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n}_total {c.value}")
+        for g in gauges:
+            n = _prom_name(g.name)
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {g.value:g}")
+        for m in monitors:
+            n = _prom_name(m.name)
+            lines.append(f"# TYPE {n}_seconds summary")
+            lines.append(f"{n}_seconds_sum {m.elapse_ms / 1e3:.9g}")
+            lines.append(f"{n}_seconds_count {m.count}")
+        for h in histograms:
+            n = _prom_name(h.name)
+            data = h.to_dict()
+            lines.append(f"# TYPE {n} histogram")
+            cum = 0
+            for bound, bucket in zip(data["bounds"], data["buckets"]):
+                cum += bucket
+                lines.append(f'{n}_bucket{{le="{bound:.9g}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {data["count"]}')
+            lines.append(f"{n}_sum {data['sum']:.9g}")
+            lines.append(f"{n}_count {data['count']}")
+        return "\n".join(lines) + ("\n" if lines else "")
 
     @classmethod
     def display(cls) -> str:
@@ -164,22 +293,36 @@ class Dashboard:
             lines = ["--------------Dashboard--------------------"]
             lines.extend(repr(m) for m in cls._monitors.values())
             lines.extend(repr(c) for c in cls._counters.values())
+            lines.extend(repr(g) for g in cls._gauges.values())
+            lines.extend(repr(h) for h in cls._histograms.values())
         text = "\n".join(lines)
         print(text, flush=True)
         return text
 
     @classmethod
     def reset(cls) -> None:
+        """Zero every registered object IN PLACE. Clearing the dicts
+        instead would orphan cached references: a module that held on to
+        ``Dashboard.counter("X")`` would keep bumping an object no longer
+        in the registry while readers see a fresh zero forever."""
         with cls._lock:
-            cls._monitors.clear()
-            cls._counters.clear()
+            objs = (list(cls._monitors.values())
+                    + list(cls._counters.values())
+                    + list(cls._histograms.values())
+                    + list(cls._gauges.values()))
+        for obj in objs:
+            obj.reset()
 
 
 @contextmanager
 def monitor(name: str) -> Iterator[Monitor]:
-    """``MONITOR_BEGIN(name) ... MONITOR_END(name)`` as a context manager."""
+    """``MONITOR_BEGIN(name) ... MONITOR_END(name)`` as a context manager.
+    The duration feeds BOTH the monitor (count/total/average) and the
+    same-named histogram (p50/p95/p99) — every timed section gets a
+    distribution for free. Timing is a local on the caller's stack, so
+    overlapping scopes on any thread mix cannot corrupt each other."""
     mon = Dashboard.get(name)
-    mon.begin()
+    t0 = time.perf_counter()
     ann = None
     if Dashboard.profile_annotations and _TraceAnnotation is not None:
         ann = _TraceAnnotation(name)
@@ -189,12 +332,29 @@ def monitor(name: str) -> Iterator[Monitor]:
     finally:
         if ann is not None:
             ann.__exit__(None, None, None)
-        mon.end()
+        dt = time.perf_counter() - t0
+        mon.observe(dt)
+        Dashboard.histogram(name).observe(dt)
 
 
 def count(name: str, n: int = 1) -> None:
     """Bump a named event counter (``Dashboard.counter(name).add(n)``)."""
     Dashboard.counter(name).add(n)
+
+
+def observe(name: str, seconds: float) -> None:
+    """Record one sample into a named histogram."""
+    Dashboard.histogram(name).observe(seconds)
+
+
+def gauge_set(name: str, value: float) -> None:
+    """Set a named gauge (last writer wins)."""
+    Dashboard.gauge(name).set(value)
+
+
+def gauge_add(name: str, delta: float = 1.0) -> None:
+    """Atomically add to a named gauge."""
+    Dashboard.gauge(name).add(delta)
 
 
 class Timer:

@@ -41,6 +41,7 @@ from multiverso_tpu import config, log
 from multiverso_tpu import io as mv_io
 from multiverso_tpu.dashboard import count
 from multiverso_tpu.fault.detector import LivenessDetector
+from multiverso_tpu.obs.trace import flight_dump
 from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
@@ -217,6 +218,10 @@ class WarmStandby:
                  "record(s) — taking over %s", self.records_applied,
                  self._service_endpoint)
         count("FAILOVERS")
+        # post-mortem before state changes hands: what was in flight and
+        # what the dashboard looked like when the primary's lease expired
+        flight_dump("standby_failover", primary=self._primary_endpoint,
+                    records_applied=self.records_applied)
         self._stop.set()
         self._net.finalize()
         self._zoo._dedup_seeds = list(self._seeds)

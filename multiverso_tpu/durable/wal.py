@@ -47,6 +47,7 @@ import os
 import re
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -57,7 +58,8 @@ from multiverso_tpu import config, log
 from multiverso_tpu import io as mv_io
 from multiverso_tpu.checkpoint import (
     _run_serialized, load_table, read_array, write_array)
-from multiverso_tpu.dashboard import count
+from multiverso_tpu.dashboard import count, gauge_set, observe
+from multiverso_tpu.obs.trace import hop
 
 _SEG_MAGIC = b"MVWL"
 _SEG_VERSION = 1
@@ -188,6 +190,10 @@ class WalWriter:
         self._observers: List[Callable] = []
         self._lock = threading.Lock()
         self._closed = False
+        # replay debt: bytes appended since the last committed snapshot
+        # (restart recovery replays roughly this much). Starts at 0 on a
+        # resumed log — the gauge tracks THIS process's contribution.
+        self._backlog_bytes = 0
 
     # -- append path ---------------------------------------------------------
     def _seg_path(self, table_id: int, segment: int) -> str:
@@ -210,6 +216,7 @@ class WalWriter:
 
     def append(self, req_id: int, worker: int, table_id: int, msg_id: int,
                blobs: List[np.ndarray]) -> None:
+        t0 = time.perf_counter()
         record = _encode_record(req_id, worker, msg_id, blobs)
         with self._lock:
             if self._closed:
@@ -221,9 +228,17 @@ class WalWriter:
             if self.sync == "batch":
                 stream.flush()
             elif self.sync == "always":
+                t_sync = time.perf_counter()
                 stream.sync()
+                # the fsync dominates wal_sync=always appends — its own
+                # distribution separates disk stalls from encode cost
+                observe("WAL_FSYNC_SECONDS", time.perf_counter() - t_sync)
+            self._backlog_bytes += len(record)
             observers = list(self._observers)
         count("WAL_APPENDS")
+        observe("WAL_APPEND_SECONDS", time.perf_counter() - t0)
+        gauge_set("WAL_BACKLOG_BYTES", self._backlog_bytes)
+        hop(req_id, "wal_append")
         for observer in observers:
             observer(req_id, worker, table_id, msg_id, blobs)
 
@@ -260,6 +275,9 @@ class WalWriter:
                     log.error("wal: could not retire %s: %r", name, exc)
         for gen in range(max(0, old_generation), generation):
             self._remove_generation(gen)
+        with self._lock:
+            self._backlog_bytes = 0
+        gauge_set("WAL_BACKLOG_BYTES", 0)
         count("SNAPSHOT_COMPACTIONS")
         log.debug("wal: compacted to generation %d / segment %d "
                   "(%d segment file(s) retired)", generation, first_segment,

@@ -1,0 +1,21 @@
+"""Telemetry subsystem: distributions, tracing, flight recorder, metrics.
+
+What joins the PR-1/2 Counters and the reference-era Monitors
+(``dashboard.py``):
+
+* :mod:`~multiverso_tpu.obs.metrics` — log-bucketed :class:`Histogram`
+  (p50/p95/p99) and :class:`Gauge`; both live in the Dashboard registry.
+* :mod:`~multiverso_tpu.obs.trace` — ``req_id``-keyed per-request hop
+  traces and the :class:`FlightRecorder` (dump-on-anomaly JSONL).
+* :mod:`~multiverso_tpu.obs.logger` — :class:`MetricsLogger` periodic
+  JSONL snapshots (``metrics_path`` / ``metrics_interval_seconds``).
+
+Operator treatment: ``docs/observability.md`` (metric catalog, trace
+stage list, flight-recorder format, stats RPC usage).
+"""
+
+from multiverso_tpu.obs.metrics import (  # noqa: F401
+    Gauge, Histogram, StatsSnapshot, log_bounds)
+from multiverso_tpu.obs.trace import (  # noqa: F401
+    RECORDER, TRACES, FlightRecorder, TraceStore, flight_dump, hop)
+from multiverso_tpu.obs.logger import MetricsLogger, load_metrics  # noqa: F401

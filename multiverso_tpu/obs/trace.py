@@ -1,0 +1,155 @@
+"""Per-request tracing + the flight recorder.
+
+The wire ``req_id`` (fault/retry.py's idempotency key) doubles as a span
+id: every hop a correlated request takes — client send, frame decode,
+server receive, dispatcher enqueue, WAL append, sync-gate defer/release,
+apply, reply — appends ``(stage, t_ns)`` to a bounded in-memory trace.
+In-process messages carry ``req_id == 0`` and are never traced, so the
+hot local path pays nothing but a predicate.
+
+The :class:`FlightRecorder` is the post-mortem half: on an anomalous
+event (worker eviction, standby failover, frame CRC reject, a client
+failing all pending requests) it appends the last N traces plus a full
+dashboard snapshot to a JSONL file (the ``flight_recorder_path`` flag),
+so the operator sees exactly which requests were in flight, hop by hop,
+when the system misbehaved — without having had tracing "turned on" in
+advance. Telemetry must never take down the data path: every dump is
+fully guarded.
+
+Stage names are catalogued in ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+MAX_HOPS_PER_TRACE = 64
+
+
+class TraceStore:
+    """Bounded req_id -> [(stage, t_ns), ...] map. Oldest-trace eviction
+    keeps memory constant under sustained traffic; a trace that outgrows
+    ``MAX_HOPS_PER_TRACE`` (a retransmit storm) stops growing rather than
+    leaking."""
+
+    def __init__(self, max_traces: int = 512) -> None:
+        self.max_traces = int(max_traces)
+        self._traces: "OrderedDict[int, List[Tuple[str, int]]]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+
+    def hop(self, req_id: int, stage: str,
+            t_ns: Optional[int] = None) -> None:
+        if not req_id:
+            return
+        if t_ns is None:
+            t_ns = time.time_ns()
+        with self._lock:
+            hops = self._traces.get(req_id)
+            if hops is None:
+                hops = self._traces[req_id] = []
+                while len(self._traces) > self.max_traces:
+                    self._traces.popitem(last=False)
+            if len(hops) < MAX_HOPS_PER_TRACE:
+                hops.append((stage, t_ns))
+
+    def get(self, req_id: int) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._traces.get(req_id, ()))
+
+    def recent(self, n: int) -> List[Tuple[int, List[Tuple[str, int]]]]:
+        """The last ``n`` traces in insertion order (oldest first)."""
+        with self._lock:
+            items = list(self._traces.items())
+        return [(rid, list(hops)) for rid, hops in items[-n:]]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+# Process-global trace store — client and server hops of an in-process
+# round trip land in the SAME store (one process), while cross-process
+# deployments each record their own half.
+TRACES = TraceStore()
+
+
+def hop(req_id: int, stage: str) -> None:
+    """Append one hop to ``req_id``'s trace (no-op for req_id 0)."""
+    TRACES.hop(req_id, stage)
+
+
+class FlightRecorder:
+    """Dump-on-anomaly ring: appends an event line, a dashboard snapshot
+    line, and the last N trace lines to the ``flight_recorder_path`` JSONL
+    file. Configuration is read at dump time (flags may be set after
+    import); a missing/empty path disables dumping entirely."""
+
+    def __init__(self, store: TraceStore = TRACES) -> None:
+        self.store = store
+        self._lock = threading.Lock()
+
+    def dump(self, reason: str, **details: Any) -> Optional[str]:
+        """Write one dump; returns the path written, or None when the
+        recorder is disabled. Never raises — a failing dump is logged and
+        swallowed (telemetry must not take down the data path)."""
+        from multiverso_tpu import config, log
+        try:
+            path = str(config.get_flag("flight_recorder_path"))
+            if not path:
+                return None
+            n = max(1, int(config.get_flag("flight_recorder_traces")))
+            lines = self._render(reason, n, details)
+            with self._lock:
+                with open(path, "a", encoding="utf-8") as fp:
+                    fp.write(lines)
+        except Exception as exc:  # noqa: BLE001 — never propagate
+            try:
+                log.error("flight recorder: dump for %r failed: %r",
+                          reason, exc)
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+        from multiverso_tpu.dashboard import count
+        count("FLIGHT_DUMPS")
+        log.info("flight recorder: dumped %r (+%d trace(s)) -> %s",
+                 reason, min(n, len(self.store)), path)
+        return path
+
+    def _render(self, reason: str, n: int, details: Dict[str, Any]) -> str:
+        from multiverso_tpu.dashboard import Dashboard
+        out = [json.dumps({"kind": "event", "reason": reason,
+                           "t_ns": time.time_ns(),
+                           **{k: _jsonable(v) for k, v in details.items()}})]
+        out.append(json.dumps({"kind": "snapshot",
+                               **Dashboard.snapshot()}))
+        for req_id, hops in self.store.recent(n):
+            out.append(json.dumps({
+                "kind": "trace", "req_id": req_id,
+                "hops": [[stage, t_ns] for stage, t_ns in hops]}))
+        return "\n".join(out) + "\n"
+
+
+def _jsonable(value: Any) -> Any:
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+RECORDER = FlightRecorder()
+
+
+def flight_dump(reason: str, **details: Any) -> Optional[str]:
+    """Trigger a flight-recorder dump (module-level seam the runtime
+    calls on eviction / failover / CRC reject / unclean shutdown)."""
+    return RECORDER.dump(reason, **details)

@@ -45,6 +45,11 @@ class MsgType(enum.IntEnum):
     Control_Replicate = 37
     Control_Reply_Replicate = -37
     Control_Wal_Record = 38
+    # live stats RPC (obs/): mv.stats(endpoint) pulls a remote server's
+    # full dashboard — monitors, counters, gauges, histograms serialized
+    # as bucket arrays — without registering a worker slot
+    Control_Stats = 39
+    Control_Reply_Stats = -39
 
     @property
     def is_server_bound(self) -> bool:

@@ -45,6 +45,7 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from multiverso_tpu import config, log
+from multiverso_tpu.dashboard import monitor
 from multiverso_tpu.runtime.message import Message, MsgType
 
 # flags: multihost_endpoint / multihost_timeout / multihost_token (defined
@@ -373,9 +374,12 @@ class FollowerServer:
         request = msg.data[0] if msg.data else None
         if completion is not None:
             self._runtime.register_pending(msg.msg_id, completion)
-        self._runtime.send_to_leader(
-            ("req", int(msg.type), msg.table_id, msg.src, msg.msg_id,
-             request))
+        # follower hop cost (serialize + control-plane enqueue): the
+        # same-named histogram gives its distribution via mv.stats/render
+        with monitor("FOLLOWER_FORWARD_MSG"):
+            self._runtime.send_to_leader(
+                ("req", int(msg.type), msg.table_id, msg.src, msg.msg_id,
+                 request))
 
     # replay executor ------------------------------------------------------
     def execute(self, seq: int, op: str, table_id: int, origin: int,
@@ -384,9 +388,11 @@ class FollowerServer:
         try:
             table = self._tables[table_id]
             if op == "add":
-                result = table.process_add(request)
+                with monitor("FOLLOWER_REPLAY_ADD_MSG"):
+                    result = table.process_add(request)
             elif op == "get":
-                result = table.process_get(request)
+                with monitor("FOLLOWER_REPLAY_GET_MSG"):
+                    result = table.process_get(request)
             elif op == "store":
                 # only the collective (device->host read) matters here;
                 # the bytes go to a null sink — the leader owns the file

@@ -28,13 +28,15 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from multiverso_tpu import log
-from multiverso_tpu.dashboard import count
+from multiverso_tpu.dashboard import count, observe
+from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
@@ -223,6 +225,7 @@ class TcpNet:
     # -- internals ----------------------------------------------------------
     @staticmethod
     def _frame(msg: Message, channel: int) -> bytes:
+        t0 = time.perf_counter()
         parts = []
         for arr in msg.data:
             head, payload = _pack_blob(np.asarray(arr))
@@ -233,6 +236,7 @@ class TcpNet:
                               int(msg.type), msg.table_id, msg.msg_id,
                               msg.req_id, len(msg.data), len(payload),
                               zlib.crc32(payload))
+        observe("FRAME_ENCODE_SECONDS", time.perf_counter() - t0)
         return header + payload
 
     def _send(self, msg: Message, channel: int) -> int:
@@ -321,7 +325,11 @@ class TcpNet:
                     log.error("net: CRC mismatch on %s frame from %d — "
                               "frame discarded (retransmit recovers it)",
                               MsgType(mtype), src)
+                    hop(req_id, "net_crc_reject")
+                    flight_dump("frame_crc_reject", src=src,
+                                msg_type=int(mtype), req_id=req_id)
                     continue
+                t0 = time.perf_counter()
                 off = 0
                 blobs = []
                 for _ in range(nblobs):
@@ -334,6 +342,8 @@ class TcpNet:
                         payload, dtype=dtype, count=nbytes // dtype.itemsize,
                         offset=off).reshape(shape).copy())
                     off += nbytes
+                observe("FRAME_DECODE_SECONDS", time.perf_counter() - t0)
+                hop(req_id, "net_recv")
                 msg = Message(src=src, dst=dst, type=MsgType(mtype),
                               table_id=table_id, msg_id=msg_id,
                               req_id=req_id, data=blobs)

@@ -48,11 +48,14 @@ import numpy as np
 
 from multiverso_tpu import config, log
 from multiverso_tpu import io as mv_io
-from multiverso_tpu.dashboard import count
+from multiverso_tpu.dashboard import Dashboard, count, gauge_set, observe
 from multiverso_tpu.fault.detector import LivenessDetector
 from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.fault.retry import RetryPolicy
+from multiverso_tpu.obs.metrics import StatsSnapshot
+from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
+from multiverso_tpu.runtime.net import TcpNet
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.tables.array_table import ArrayWorker
 from multiverso_tpu.tables.base import Completion, WorkerTable
@@ -94,6 +97,7 @@ class _NetCompletion:
                       table_id=t.table_id, msg_id=t.msg_id, req_id=t.req_id,
                       data=wire.encode(payload, compress=self._compress))
         self._server._dedup_store(t.req_id, msg)
+        hop(t.req_id, "reply_sent")
         try:
             self._server._net.send_via(self._conn, msg)
         except OSError as exc:
@@ -186,8 +190,10 @@ class RemoteServer:
                 self._dedup[msg.req_id] = _INFLIGHT
                 while len(self._dedup) > self._dedup_max:
                     self._dedup.popitem(last=False)
+                gauge_set("SERVER_DEDUP_OCCUPANCY", len(self._dedup))
                 return False
         count("SERVER_DEDUP_HITS")
+        hop(msg.req_id, "server_dedup_hit")
         if hit is not _INFLIGHT:
             try:
                 self._net.send_via(msg._conn, hit)
@@ -332,7 +338,11 @@ class RemoteServer:
             # ANY frame from a worker renews its lease; dedicated
             # heartbeats only matter while the client idles or blocks
             self.liveness.beat(msg.src)
+        hop(msg.req_id, "server_recv")
         if msg.type == MsgType.Control_Heartbeat:
+            return
+        if msg.type == MsgType.Control_Stats:
+            self._reply_stats(msg)
             return
         if msg.type == MsgType.Control_Register:
             if not self._replayed(msg):
@@ -356,9 +366,12 @@ class RemoteServer:
             return
         request = wire.decode(msg.data)
         completion = _NetCompletion(self, msg._conn, msg, compress)
+        # req_id rides into the dispatcher so server-side stages (gate
+        # defer/release, WAL append, apply) land on the request's trace
         forward = Message(
             src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
-            msg_id=msg.msg_id, data=[request, completion])
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=[request, completion])
         if (msg.type == MsgType.Request_Add and msg.req_id
                 and self._zoo.server.wal is not None):
             # raw wire blobs ride along for the dispatcher's write-ahead
@@ -366,7 +379,19 @@ class RemoteServer:
             # replayed through wire.decode at recovery
             forward._wal = (msg.req_id, msg.src, msg.table_id, msg.msg_id,
                             msg.data)
+        hop(msg.req_id, "dispatch_enqueue")
         self._zoo.server.send(forward)
+
+    def _reply_stats(self, msg: Message) -> None:
+        """Control_Stats: ship this process's full dashboard — monitors,
+        counters, gauges, histograms as bucket arrays — back over the
+        probing connection. No worker slot, no lease, no dedup entry: a
+        stats probe must stay readable even when every slot is taken or
+        the clock gates are wedged (that is when an operator needs it)."""
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Stats,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=wire.encode(Dashboard.snapshot())))
 
     def _deregister_client(self, msg: Message) -> None:
         # Graceful close. Slot recycling is async-server only: the sync
@@ -479,6 +504,54 @@ class RemoteServer:
                                    "tables": directory})
 
 
+# -- stats probe --------------------------------------------------------------
+
+def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
+    """One-shot live stats RPC: dial ``endpoint``, send ``Control_Stats``,
+    return the server's dashboard as a :class:`StatsSnapshot` (histograms
+    rebuilt from their bucket arrays, so p50/p95/p99 compute caller-side
+    on the server's exact counts). Deliberately NOT a RemoteClient: no
+    worker slot, no lease, no chaos transport — a diagnostic probe must
+    work when the data plane is the thing being diagnosed."""
+    net = TcpNet()
+    net.rank = -1
+    net.connect([endpoint])
+    msg_id = next_msg_id()
+    got = threading.Event()
+    box: Dict[str, Message] = {}
+
+    def pump() -> None:
+        try:
+            while True:
+                msg = net.recv()
+                if msg is None:
+                    return
+                if msg.msg_id == msg_id:
+                    box["reply"] = msg
+                    got.set()
+                    return
+        except ConnectionError:
+            got.set()
+
+    threading.Thread(target=pump, daemon=True, name="mv-stats-probe").start()
+    try:
+        net.send(Message(src=-1, dst=0, type=MsgType.Control_Stats,
+                         msg_id=msg_id))
+        if not got.wait(timeout):
+            raise TimeoutError(f"stats probe to {endpoint} timed out "
+                               f"after {timeout:.1f}s")
+    finally:
+        net.finalize()
+    reply = box.get("reply")
+    if reply is None:
+        raise ConnectionError(f"stats probe to {endpoint}: connection "
+                              "lost before the reply")
+    if reply.type != MsgType.Control_Reply_Stats:
+        raise RuntimeError(f"stats probe to {endpoint}: unexpected reply "
+                           f"{reply.type}")
+    return StatsSnapshot(wire.decode(reply.data))
+
+
 # -- client side -------------------------------------------------------------
 
 class RemoteChannel:
@@ -500,13 +573,16 @@ class RemoteChannel:
 
 class _Inflight:
     """One outstanding correlated request: the framed message (for
-    retransmission) plus its retry clock."""
+    retransmission) plus its retry clock. ``first`` is the issue time —
+    the request-latency histogram measures from here, so retransmits
+    lengthen (never reset) the observed latency."""
 
-    __slots__ = ("msg", "sent", "attempts")
+    __slots__ = ("msg", "sent", "first", "attempts")
 
     def __init__(self, msg: Message, sent: float) -> None:
         self.msg = msg
         self.sent = sent
+        self.first = sent
         self.attempts = 0
 
 
@@ -623,6 +699,8 @@ class RemoteClient:
             if completion is not None:
                 self._pending[msg_id] = completion
                 self._inflight[msg_id] = _Inflight(msg, time.monotonic())
+                gauge_set("CLIENT_INFLIGHT", len(self._inflight))
+                hop(msg.req_id, "client_send")
             if self._recovering:
                 # recovery retransmits the whole inflight set (in req_id
                 # order) once re-registered; sending now would race it
@@ -648,9 +726,16 @@ class RemoteClient:
                 return
             with self._lock:
                 completion = self._pending.pop(msg.msg_id, None)
-                self._inflight.pop(msg.msg_id, None)
+                flight = self._inflight.pop(msg.msg_id, None)
+                gauge_set("CLIENT_INFLIGHT", len(self._inflight))
             if completion is None:
                 continue  # duplicate reply (retransmit + dedup): settled
+            if flight is not None:
+                # end-to-end request latency, retransmits included — the
+                # distribution mv.stats() reports as CLIENT_REQUEST_SECONDS
+                observe("CLIENT_REQUEST_SECONDS",
+                        time.monotonic() - flight.first)
+            hop(msg.req_id, "client_reply")
             try:
                 if msg.type == MsgType.Reply_Error:
                     completion.fail(RuntimeError(
@@ -709,6 +794,7 @@ class RemoteClient:
                     for flight in backlog:
                         flight.attempts += 1
                         flight.sent = now
+                        hop(flight.msg.req_id, "client_resume_retransmit")
                         try:
                             self._net.send(flight.msg)
                         except OSError as exc:
@@ -776,6 +862,7 @@ class RemoteClient:
                 flight.sent = now
         for flight in stale:
             count("CLIENT_RETRIES")
+            hop(flight.msg.req_id, "client_retransmit")
             log.debug("remote client %d: retransmitting %s (attempt %d)",
                       self.worker_id, flight.msg.type, flight.attempts)
             try:
@@ -789,6 +876,12 @@ class RemoteClient:
             pending = list(self._pending.values())
             self._pending.clear()
             self._inflight.clear()
+            gauge_set("CLIENT_INFLIGHT", 0)
+        if pending:
+            # unclean end of session: every in-flight request dies with
+            # this error — capture the hop traces while they are fresh
+            flight_dump("client_fail_all", worker=self.worker_id,
+                        pending=len(pending), error=repr(exc))
         for completion in pending:
             completion.fail(exc)
 

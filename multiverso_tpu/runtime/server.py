@@ -21,10 +21,12 @@ round-i Adds are applied.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from multiverso_tpu import config, log
-from multiverso_tpu.dashboard import count, monitor
+from multiverso_tpu.dashboard import count, gauge_set, monitor, observe
+from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
@@ -194,8 +196,11 @@ class Server:
             msg = self._queue.pop()
             if msg is None:
                 return
+            # depth AFTER the pop = requests still waiting behind this one
+            gauge_set("SERVER_QUEUE_DEPTH", self._queue.size())
             try:
-                self._dispatch(msg)
+                with monitor("SERVER_DISPATCH_MSG"):
+                    self._dispatch(msg)
             except Exception as exc:  # keep the dispatcher alive; fail the waiter
                 log.error("server dispatcher error on %s: %r", msg.type, exc)
                 if msg.data and hasattr(msg.data[-1], "fail"):
@@ -221,6 +226,7 @@ class Server:
         with monitor("SERVER_PROCESS_ADD_MSG"):
             request, completion = msg.data
             self._wal_append(msg)
+            hop(msg.req_id, "apply_add")
             # process_add may return a fused-get payload (ArrayTable's
             # add+get sync path); plain adds return None as before
             completion.done(self._tables[msg.table_id].process_add(request))
@@ -228,6 +234,7 @@ class Server:
     def _process_get(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_GET_MSG"):
             request, completion = msg.data
+            hop(msg.req_id, "serve_get")
             result = self._tables[msg.table_id].process_get(request)
             completion.done(result)
 
@@ -411,6 +418,22 @@ class SyncServer(Server):
                 data=[lambda w=worker: self._evict_worker(w),
                       _NullCompletion()]))
 
+    # -- gate-wait telemetry (obs/): a deferred request's queue time is the
+    # tail the BSP/SSP contract creates — stamped at defer, observed at
+    # release, visible as the SYNC_GATE_WAIT_SECONDS histogram
+    @staticmethod
+    def _gate_defer(msg: Message) -> None:
+        msg._gated_at = time.perf_counter()
+        hop(msg.req_id, "gate_deferred")
+
+    @staticmethod
+    def _gate_release(msg: Message) -> None:
+        gated_at = getattr(msg, "_gated_at", None)
+        if gated_at is not None:
+            observe("SYNC_GATE_WAIT_SECONDS",
+                    time.perf_counter() - gated_at)
+        hop(msg.req_id, "gate_released")
+
     def _evict_worker(self, worker: int) -> None:
         """Remove a dead worker from every clock gate (dispatcher thread):
         mark it finished so ``_min_adds``/``_min_gets`` stop waiting on its
@@ -433,8 +456,12 @@ class SyncServer(Server):
                     pending[tid] = [m for m in pending[tid]
                                     if m.src != worker]
                     for msg in mine:
+                        hop(msg.req_id, "gate_failed_eviction")
                         msg.data[-1].fail(exc)
             self._drain(tid)
+        # post-mortem: the last N request traces (including the corpse's
+        # deferred ones, hop by hop) + a dashboard snapshot
+        flight_dump("worker_evicted", worker=worker)
 
     def register_table(self, server_table) -> int:
         table_id = super().register_table(server_table)
@@ -484,6 +511,7 @@ class SyncServer(Server):
             self._add_clock[tid][worker] = round_
             self._drain(tid)
         else:
+            self._gate_defer(msg)
             self._pending_add[tid].append(msg)
 
     def _process_get(self, msg: Message) -> None:
@@ -501,6 +529,7 @@ class SyncServer(Server):
             completion.done(result)
             self._drain(tid)
         else:
+            self._gate_defer(msg)
             self._pending_get[tid].append(msg)
 
     def _process_finish_train(self, msg: Message) -> None:
@@ -521,6 +550,7 @@ class SyncServer(Server):
                 worker = msg.src
                 round_ = self._get_clock[table_id][worker] + 1
                 if self._min_adds(table_id) >= round_:
+                    self._gate_release(msg)
                     request, completion = msg.data
                     result = self._tables[table_id].process_get(request)
                     self._get_clock[table_id][worker] = round_
@@ -534,6 +564,7 @@ class SyncServer(Server):
                 worker = msg.src
                 round_ = self._add_clock[table_id][worker] + 1
                 if self._min_gets(table_id) >= round_ - 1:
+                    self._gate_release(msg)
                     request, completion = msg.data
                     self._wal_append(msg)
                     completion.done(
@@ -574,8 +605,14 @@ class SSPServer(SyncServer):
             return
         request, completion = msg.data
         self._wal_append(msg)
+        hop(msg.req_id, "apply_add")
         completion.done(self._tables[tid].process_add(request))
         self._add_clock[tid][worker] += 1
+        # observed staleness: how many add-rounds this worker now leads
+        # the slowest unfinished worker by (0 = in lockstep; bounded by
+        # the staleness flag for its Gets to be served)
+        gauge_set(f"SSP_STALENESS_W{worker}",
+                  self._add_clock[tid][worker] - self._min_adds(tid))
         self._drain(tid)
 
     def _gate_round(self, tid: int, worker: int) -> int:
@@ -595,6 +632,7 @@ class SSPServer(SyncServer):
             self._get_clock[tid][worker] += 1
             completion.done(result)
         else:
+            self._gate_defer(msg)
             self._pending_get[tid].append(msg)
 
     def _drain(self, table_id: int) -> None:
@@ -603,6 +641,7 @@ class SSPServer(SyncServer):
             worker = msg.src
             if self._min_adds(table_id) >= self._gate_round(table_id,
                                                             worker):
+                self._gate_release(msg)
                 request, completion = msg.data
                 result = self._tables[table_id].process_get(request)
                 self._get_clock[table_id][worker] += 1
