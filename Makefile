@@ -11,6 +11,9 @@
 #                       corrupt mode; MV_CHAOS_ARTIFACT_DIR collects
 #                       flight-recorder dumps + metrics JSONL for upload)
 #   make failover       crash-point recovery + warm-standby failover smoke
+#   make sharded        sharded-tier smoke: 2-shard group round-trip +
+#                       one-shard-down failover (router + layout RPC +
+#                       per-shard standby; docs/sharding.md)
 #   make metrics-smoke  short remote-training session; assert the metrics
 #                       JSONL parses and key latency histograms are non-empty
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
@@ -20,7 +23,7 @@ PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos failover metrics-smoke native test dryrun bench clean
+.PHONY: check chaos failover sharded metrics-smoke native test dryrun bench clean
 
 check: native test dryrun bench
 
@@ -44,6 +47,11 @@ metrics-smoke:
 failover:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
 		tests/test_durable.py -q -k "crash_point or failover" \
+		-p no:cacheprovider -p no:randomly
+
+sharded:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_shard.py -q \
+		-k "shard_group or layout_rpc" \
 		-p no:cacheprovider -p no:randomly
 
 dryrun:

@@ -613,6 +613,69 @@ def bench_multihost_ps(world: int = 2, devices_per_proc: int = 4):
     }
 
 
+def bench_sharded(shards, rows=4096, cols=32, batch_rows=256,
+                  n_batches=240, window=32):
+    """Sharded serving-tier throughput (docs/sharding.md): MatrixTable
+    row Adds through the ShardedClient router against a local
+    ``shards``-process ShardGroup, next to the SAME workload against a
+    1-shard group — an apples-to-apples scaling ratio (both sides pay the
+    router + wire path; only the server fan-out differs). Reports
+    aggregate adds/rows per second plus each shard's served-Add count and
+    dispatcher p50 from the live stats RPC, so BENCH_*.json records a
+    scaling curve per run. Local groups run CPU children — this measures
+    the serving machinery (dispatcher fan-out), not accelerator silicon."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.shard.group import ShardGroup
+
+    def run_group(n):
+        group = ShardGroup(
+            [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+            shards=n, flags={"remote_workers": 4}).start()
+        try:
+            client = group.connect()
+            table = client.table(0)
+            rng = np.random.default_rng(0)
+            batches = [rng.choice(rows, batch_rows, replace=False)
+                       .astype(np.int32) for _ in range(16)]
+            vals = np.ones((batch_rows, cols), np.float32)
+            for b in batches[:4]:  # warm every shard's jit buckets
+                table.add(vals, row_ids=b)
+            handles = []
+            t0 = time.perf_counter()
+            for i in range(n_batches):
+                handles.append(table.add_async(vals,
+                                               row_ids=batches[i % 16]))
+                if len(handles) >= window:
+                    table.wait(handles.pop(0))
+            for h in handles:
+                table.wait(h)
+            dt = time.perf_counter() - t0
+            merged = mv.stats_all(group.endpoints)
+            per_shard = {}
+            for k, sub in enumerate(merged.shards):
+                hist = sub.histogram("SERVER_PROCESS_ADD_MSG")
+                per_shard[f"shard{k}"] = {
+                    "adds_served": hist.count if hist else 0,
+                    "add_p50_us": round((hist.p50 if hist else 0.0) * 1e6,
+                                        1)}
+            client.close()
+            return n_batches / dt, per_shard
+        finally:
+            group.stop()
+
+    sharded_bps, per_shard = run_group(shards)
+    single_bps, _ = run_group(1)
+    return {
+        "shards": shards,
+        "sharded_row_adds_per_sec": round(sharded_bps * batch_rows, 1),
+        "sharded_batches_per_sec": round(sharded_bps, 1),
+        "single_row_adds_per_sec": round(single_bps * batch_rows, 1),
+        "sharded_scaling_x": round(sharded_bps / single_bps, 2),
+        "sharded_batch_rows": batch_rows,
+        "per_shard": per_shard,
+    }
+
+
 def probe_gbps(probe_mb=128):
     """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
     donated-pass loop, min-of-3. ~1s; the load thermometer every gated
@@ -701,6 +764,11 @@ def main():
         mh = bench_multihost_ps()
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         mh = {"multihost_error": repr(exc)[:300]}
+    import os
+    try:
+        sharded = bench_sharded(int(os.environ.get("MV_BENCH_SHARDS", "2")))
+    except Exception as exc:  # the spawn leg must not sink the TPU figures
+        sharded = {"sharded_error": repr(exc)[:300]}
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
@@ -720,6 +788,7 @@ def main():
         **matrix,
         **resnet,
         **mh,
+        **sharded,
     }
     if pre_probe is not None:
         # shared-chip load probes (quiet ~760+ GB/s): the pre-run value
@@ -733,6 +802,16 @@ def main():
     print(json.dumps(result))
 
 
+def _parse_shards_arg(argv):
+    """``--shards N`` / ``--shards=N`` -> N, or None when absent."""
+    for i, arg in enumerate(argv):
+        if arg == "--shards" and i + 1 < len(argv):
+            return int(argv[i + 1])
+        if arg.startswith("--shards="):
+            return int(arg.split("=", 1)[1])
+    return None
+
+
 if __name__ == "__main__":
     import sys
     # spawn_lockstep_world child argv: rank world coord ctl scenario
@@ -740,4 +819,11 @@ if __name__ == "__main__":
         _multihost_child(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
                          sys.argv[4])
     else:
-        main()
+        shards = _parse_shards_arg(sys.argv[1:])
+        if shards is not None:
+            # sharded-tier scaling run only: spin a local ShardGroup and
+            # report aggregate + per-shard throughput vs single-server
+            print(json.dumps({"metric": "sharded_row_adds_per_sec",
+                              **bench_sharded(shards)}))
+        else:
+            main()

@@ -273,6 +273,65 @@ def stats(endpoint: str, timeout: float = 10.0):
     return fetch_stats(endpoint, timeout=timeout)
 
 
+# -- sharded serving tier (multiverso_tpu/shard/, docs/sharding.md) ----------
+# The reference's horizontal-scaling story: tables range/hash-sharded across
+# server ranks, clients splitting requests and merging partial replies. Here
+# a ShardGroup launches one serving process per shard (own WAL, leases,
+# optional warm standby) and clients route through a ShardedClient.
+
+def serve_sharded(tables: Sequence[dict], shards: Optional[int] = None,
+                  **kwargs: Any):
+    """Launch a shard group serving ``tables`` (declarative specs, e.g.
+    ``[{"kind": "matrix", "num_row": 1 << 20, "num_col": 64}]``) across
+    ``shards`` serving processes (default: the ``shards`` flag). Each
+    shard owns its slice of every table, its own lease table and dedup
+    window, its own WAL dir (``durable=True``), and optionally a warm
+    standby (``standby=True``). Returns the started
+    :class:`~multiverso_tpu.shard.group.ShardGroup` — use ``.connect()``
+    for a routing client, ``.endpoints``/``.layout`` for bootstrap info,
+    ``.stop()`` to tear down. Does NOT need ``mv.init`` in the calling
+    process (the shard children own their runtimes)."""
+    from multiverso_tpu.shard.group import ShardGroup
+    return ShardGroup(tables, shards=shards, **kwargs).start()
+
+
+def shard_connect(endpoints: Any = None, timeout: float = 30.0):
+    """Connect to an existing shard group: fetch the layout manifest from
+    the first reachable member (``Control_Layout`` RPC), then build a
+    :class:`~multiverso_tpu.shard.router.ShardedClient` whose
+    ``.table(table_id)`` proxies split Get/Add across the shards and
+    merge the partial replies bit-identically to a single-server run.
+    ``endpoints``: a host:port string, a list of them, or None to read
+    the ``shard_endpoints`` flag (validated fail-fast)."""
+    from multiverso_tpu.shard.partition import parse_shard_endpoints
+    from multiverso_tpu.shard.router import ShardedClient, fetch_layout
+    if endpoints is None:
+        endpoints = get_flag("shard_endpoints")
+    candidates = parse_shard_endpoints(endpoints)
+    errors = []
+    for endpoint in candidates:
+        try:
+            layout = fetch_layout(endpoint, timeout=timeout)
+            return ShardedClient(layout, timeout=timeout)
+        except (OSError, TimeoutError, ConnectionError, RuntimeError) as exc:
+            errors.append(f"{endpoint}: {exc!r}")
+    log.fatal("shard_connect: no member answered the layout RPC (%s)",
+              "; ".join(errors))
+
+
+def stats_all(endpoints: Any, timeout: float = 10.0):
+    """Fan ``mv.stats`` across a shard group and merge: counters summed,
+    histograms merged by bucket addition (quantiles compute on the union
+    of the members' exact counts), with per-shard sub-views kept on
+    ``.shards``. ``endpoints``: list of host:port, a comma-separated
+    string, or a :class:`~multiverso_tpu.shard.group.ShardGroup`."""
+    from multiverso_tpu.obs.metrics import merge_stats
+    from multiverso_tpu.shard.partition import parse_shard_endpoints
+    endpoints = getattr(endpoints, "endpoints", endpoints)
+    return merge_stats(stats(e, timeout=timeout)
+                       for e in parse_shard_endpoints(endpoints))
+
+
 def stop_serving() -> None:
     """Stop the remote table server while keeping the runtime up. A later
     ``serve()`` binds fresh — the server-restart recovery path: restart,

@@ -252,6 +252,21 @@ define_string("flight_recorder_path", "",
 define_int("flight_recorder_traces", 256,
            "how many recent request traces each flight-recorder dump "
            "includes (the in-memory trace ring holds at least this many)")
+# Sharded serving tier (multiverso_tpu/shard/): table partitioning,
+# client-side router, shard groups with per-shard failover
+# (docs/sharding.md).
+define_int("shards", 0,
+           "shard count for sharded serving (mv.serve_sharded spawns one "
+           "serving process per shard); 0 = unsharded single server")
+define_string("shard_partitioner", "auto",
+              "partitioner for key tables in a shard group: auto|range|"
+              "hash (array/matrix rows are always range-partitioned); "
+              "unknown values fail fast with the accepted set")
+define_string("shard_endpoints", "",
+              "comma-separated host:port members of an existing shard "
+              "group — mv.shard_connect() bootstraps the layout manifest "
+              "from the first reachable member; entries are validated "
+              "fail-fast")
 define_string("wal_sync", "batch",
               "WAL durability barrier per append: none (buffered — the "
               "tail can be lost even to a process crash), batch (flush to "

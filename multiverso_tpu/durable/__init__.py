@@ -20,6 +20,17 @@ with the process. This package closes that:
 See docs/fault_tolerance.md §7 for the operator story.
 """
 
+import os as _os
+
 from multiverso_tpu.durable.wal import (  # noqa: F401
     RecoveryResult, WalRecord, WalWriter, read_manifest, recover)
 from multiverso_tpu.durable.standby import WarmStandby  # noqa: F401
+
+
+def shard_wal_dir(root: str, shard: int) -> str:
+    """Per-shard durability root under a shard group's base directory:
+    ``<root>/shard<k>``. One WAL + snapshot lineage per shard — a shard's
+    crash/recovery/compaction never touches its peers' logs, and a
+    restarted member finds its own manifest by shard id alone
+    (docs/sharding.md)."""
+    return _os.path.join(str(root), f"shard{int(shard)}")

@@ -197,6 +197,9 @@ class StatsSnapshot:
         self._histograms = {
             name: Histogram.from_dict(name, data)
             for name, data in raw.get("histograms", {}).items()}
+        # per-member sub-views when this snapshot is a merged shard-group
+        # view (mv.stats_all / merge_stats); empty for a single server
+        self.shards: List["StatsSnapshot"] = []
 
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._histograms.get(name)
@@ -214,4 +217,57 @@ class StatsSnapshot:
     def __repr__(self) -> str:
         return (f"StatsSnapshot({len(self.monitors)} monitors, "
                 f"{len(self.counters)} counters, {len(self.gauges)} gauges, "
-                f"{len(self._histograms)} histograms)")
+                f"{len(self._histograms)} histograms"
+                + (f", merged over {len(self.shards)} shards"
+                   if self.shards else "") + ")")
+
+
+def merge_stats(snapshots) -> StatsSnapshot:
+    """Fold several members' dashboards into ONE StatsSnapshot — the
+    ``mv.stats_all`` merge: counters and gauges sum, monitors sum their
+    counts/elapse (average recomputed), histograms merge by BUCKET
+    ADDITION so quantiles of the merged view compute on the union of the
+    members' exact counts (averaging per-member quantiles would be
+    wrong). The members survive as ``.shards`` sub-views."""
+    snapshots = list(snapshots)
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    monitors: Dict[str, Dict[str, Any]] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    for snap in snapshots:
+        for name, value in snap.counters.items():
+            counters[name] = counters.get(name, 0) + int(value)
+        for name, value in snap.gauges.items():
+            gauges[name] = gauges.get(name, 0.0) + float(value)
+        for name, mon in snap.monitors.items():
+            agg = monitors.setdefault(name, {"count": 0, "elapse_ms": 0.0})
+            agg["count"] += int(mon.get("count", 0))
+            agg["elapse_ms"] += float(mon.get("elapse_ms", 0.0))
+        for name, hist in snap._histograms.items():
+            data = hist.to_dict()
+            agg = hists.get(name)
+            if agg is None:
+                hists[name] = {"bounds": list(data["bounds"]),
+                               "buckets": list(data["buckets"]),
+                               "overflow": data["overflow"],
+                               "count": data["count"],
+                               "sum": data["sum"],
+                               "max": data["max"]}
+                continue
+            if agg["bounds"] != list(data["bounds"]):
+                # differently-bucketed members cannot add bucket-wise;
+                # keep the first member's view (sub-views stay exact)
+                continue
+            agg["buckets"] = [a + b for a, b in zip(agg["buckets"],
+                                                    data["buckets"])]
+            agg["overflow"] += data["overflow"]
+            agg["count"] += data["count"]
+            agg["sum"] += data["sum"]
+            agg["max"] = max(agg["max"], data["max"])
+    for name, agg in monitors.items():
+        agg["average_ms"] = (agg["elapse_ms"] / agg["count"]
+                             if agg["count"] else 0.0)
+    merged = StatsSnapshot({"monitors": monitors, "counters": counters,
+                            "gauges": gauges, "histograms": hists})
+    merged.shards = snapshots
+    return merged

@@ -50,6 +50,11 @@ class MsgType(enum.IntEnum):
     # as bucket arrays — without registering a worker slot
     Control_Stats = 39
     Control_Reply_Stats = -39
+    # shard layout RPC (shard/): any member of a shard group answers with
+    # the group's layout manifest (endpoints + per-table partitioner
+    # specs) so clients bootstrap from one known endpoint
+    Control_Layout = 40
+    Control_Reply_Layout = -40
 
     @property
     def is_server_bound(self) -> bool:

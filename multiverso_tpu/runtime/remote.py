@@ -147,6 +147,12 @@ class RemoteServer:
         self.liveness = LivenessDetector(
             float(config.get_flag("lease_seconds")))
         self.endpoint: Optional[str] = None
+        # shard-group membership (shard/group.py): the layout manifest
+        # this member serves over Control_Layout — either the dict
+        # itself, or a path loaded lazily (the group publishes the file
+        # only after every member has bound its endpoint)
+        self.layout: Optional[Dict[str, Any]] = None
+        self.layout_path: str = ""
 
     def serve(self, endpoint: str = "127.0.0.1:0") -> str:
         """Bind + start the pump; returns the dialable endpoint."""
@@ -344,6 +350,9 @@ class RemoteServer:
         if msg.type == MsgType.Control_Stats:
             self._reply_stats(msg)
             return
+        if msg.type == MsgType.Control_Layout:
+            self._reply_layout(msg)
+            return
         if msg.type == MsgType.Control_Register:
             if not self._replayed(msg):
                 self._register_client(msg)
@@ -392,6 +401,31 @@ class RemoteServer:
             src=0, dst=msg.src, type=MsgType.Control_Reply_Stats,
             msg_id=msg.msg_id, req_id=msg.req_id,
             data=wire.encode(Dashboard.snapshot())))
+
+    def _reply_layout(self, msg: Message) -> None:
+        """Control_Layout: ship the shard group's layout manifest. Like
+        the stats probe: no worker slot, no lease, no dedup entry — a
+        bootstrapping client must be able to ask ANY member."""
+        layout = self.layout
+        if layout is None and self.layout_path:
+            try:
+                import json
+                with open(self.layout_path, "r", encoding="utf-8") as f:
+                    layout = self.layout = json.load(f)
+            except (OSError, ValueError):
+                layout = None  # manifest not published yet — reply error
+        if layout is None:
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Reply_Error,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode("no shard layout: this server is not a "
+                                 "shard-group member (or the group's "
+                                 "manifest is not published yet)")))
+            return
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Layout,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=wire.encode(layout)))
 
     def _deregister_client(self, msg: Message) -> None:
         # Graceful close. Slot recycling is async-server only: the sync
@@ -498,21 +532,31 @@ class RemoteServer:
         for table_id, table in list(self._zoo.server._tables.items()):
             spec = table.remote_spec()
             if spec is not None:
-                directory.append({"table_id": table_id, **spec})
+                entry = {"table_id": table_id, **spec}
+                offset = int(getattr(table, "row_offset", 0) or 0)
+                if offset:
+                    # range-sharded member: this table's rows/keys sit at
+                    # [offset, offset + local size) of the global table —
+                    # introspection for routers and operators
+                    entry["row_offset"] = offset
+                directory.append(entry)
         self._register_reply(msg, {"worker_id": worker_id,
                                    "num_workers": self._zoo.num_workers,
                                    "tables": directory})
 
 
-# -- stats probe --------------------------------------------------------------
+# -- one-shot control probes --------------------------------------------------
 
-def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
-    """One-shot live stats RPC: dial ``endpoint``, send ``Control_Stats``,
-    return the server's dashboard as a :class:`StatsSnapshot` (histograms
-    rebuilt from their bucket arrays, so p50/p95/p99 compute caller-side
-    on the server's exact counts). Deliberately NOT a RemoteClient: no
-    worker slot, no lease, no chaos transport — a diagnostic probe must
-    work when the data plane is the thing being diagnosed."""
+def control_probe(endpoint: str, request_type: MsgType,
+                  reply_type: MsgType, timeout: float = 10.0,
+                  what: str = "probe") -> Any:
+    """Dial ``endpoint``, send one control frame, return the decoded
+    reply payload. The shared skeleton under the stats and layout RPCs —
+    deliberately NOT a RemoteClient: no worker slot, no lease, no chaos
+    transport, because a diagnostic/bootstrap probe must work when the
+    data plane is the thing being diagnosed. A ``Reply_Error`` answer
+    (e.g. asking a non-member for a shard layout) raises RuntimeError
+    with the server's message."""
     net = TcpNet()
     net.rank = -1
     net.connect([endpoint])
@@ -533,23 +577,35 @@ def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
         except ConnectionError:
             got.set()
 
-    threading.Thread(target=pump, daemon=True, name="mv-stats-probe").start()
+    threading.Thread(target=pump, daemon=True,
+                     name=f"mv-{what}-probe").start()
     try:
-        net.send(Message(src=-1, dst=0, type=MsgType.Control_Stats,
-                         msg_id=msg_id))
+        net.send(Message(src=-1, dst=0, type=request_type, msg_id=msg_id))
         if not got.wait(timeout):
-            raise TimeoutError(f"stats probe to {endpoint} timed out "
+            raise TimeoutError(f"{what} probe to {endpoint} timed out "
                                f"after {timeout:.1f}s")
     finally:
         net.finalize()
     reply = box.get("reply")
     if reply is None:
-        raise ConnectionError(f"stats probe to {endpoint}: connection "
+        raise ConnectionError(f"{what} probe to {endpoint}: connection "
                               "lost before the reply")
-    if reply.type != MsgType.Control_Reply_Stats:
-        raise RuntimeError(f"stats probe to {endpoint}: unexpected reply "
+    if reply.type == MsgType.Reply_Error:
+        raise RuntimeError(f"{what} probe to {endpoint} refused: "
+                           f"{wire.decode(reply.data)}")
+    if reply.type != reply_type:
+        raise RuntimeError(f"{what} probe to {endpoint}: unexpected reply "
                            f"{reply.type}")
-    return StatsSnapshot(wire.decode(reply.data))
+    return wire.decode(reply.data)
+
+
+def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
+    """One-shot live stats RPC: the server's dashboard as a
+    :class:`StatsSnapshot` (histograms rebuilt from their bucket arrays,
+    so p50/p95/p99 compute caller-side on the server's exact counts)."""
+    return StatsSnapshot(control_probe(endpoint, MsgType.Control_Stats,
+                                       MsgType.Control_Reply_Stats,
+                                       timeout=timeout, what="stats"))
 
 
 # -- client side -------------------------------------------------------------

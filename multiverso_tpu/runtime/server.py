@@ -122,6 +122,15 @@ class Server:
         # their raw blobs in msg._wal and are appended via _wal_append on
         # this dispatcher thread before the add is applied/ACKed.
         self.wal = None
+        # Shard identity (shard/_child.py): a shard group runs N
+        # identical-looking serving processes, so operator-facing logs
+        # (stalls, lease evictions) carry which shard spoke; -1 = not a
+        # shard-group member.
+        self.shard_id = -1
+
+    def _ident(self) -> str:
+        """Log prefix naming this dispatcher when it is one of many."""
+        return f"shard {self.shard_id}: " if self.shard_id >= 0 else ""
 
     def _wal_append(self, msg: Message) -> None:
         """Append a wire Add's WAL entry (attached by the RemoteServer)
@@ -391,6 +400,7 @@ class SyncServer(Server):
                     lag = sorted(w for w in at_min if w not in waiting) \
                         or sorted(at_min)
                     report = (
+                        f"{self._ident()}"
                         f"sync stall: table {tid} has {n_add} deferred adds /"
                         f" {n_get} deferred gets with no progress for "
                         f"{period:.1f}s; waiting on worker(s) {lag} "
@@ -411,8 +421,8 @@ class SyncServer(Server):
         for worker in liveness.reap():
             if not 0 <= worker < self.num_workers:
                 continue
-            log.error("sync: lease expired for worker %d — evicting it "
-                      "from the round gates", worker)
+            log.error("%ssync: lease expired for worker %d — evicting it "
+                      "from the round gates", self._ident(), worker)
             self.send(Message(
                 src=-1, dst=-1, type=MsgType.Server_Execute,
                 data=[lambda w=worker: self._evict_worker(w),

@@ -1,0 +1,34 @@
+"""Sharded serving tier — table partitioning, client-side routing, shard
+groups with independent failover.
+
+The reference Multiverso scaled its parameter server horizontally by
+range-sharding every table across MPI/ZMQ server ranks, with clients
+splitting each request by range and merging the partial replies (the
+``Partition``/``ProcessReplyGet`` pair in ``include/multiverso/
+table_interface.h``); Li et al. (OSDI'14) make sharded server groups the
+core of the PS architecture. This package rebuilds that capability on the
+PR 1-3 substrate so throughput scales with server count while every shard
+keeps its own retry/dedup window, lease table, WAL, and warm standby:
+
+* :mod:`~multiverso_tpu.shard.partition` — pluggable partitioners
+  (contiguous row ranges for array/matrix tables, a stable 64-bit hash
+  for KV/sparse keys) plus the serializable layout manifest clients and
+  recovery bootstrap from.
+* :mod:`~multiverso_tpu.shard.router` — :class:`ShardedClient`, a drop-in
+  for :class:`~multiverso_tpu.runtime.remote.RemoteClient` that splits
+  Get/Add requests across per-shard ``RemoteClient``\\ s, issues the
+  sub-requests in parallel, and merges the partial replies bit-identically
+  to a single-server run.
+* :mod:`~multiverso_tpu.shard.group` — :class:`ShardGroup`, a launcher
+  that starts one serving process per shard (each with its own WAL dir
+  and optional warm standby) and publishes the layout manifest.
+
+Operator story: ``docs/sharding.md``.
+"""
+
+from multiverso_tpu.shard.partition import (  # noqa: F401
+    HashPartitioner, RangePartitioner, make_partitioner,
+    partitioner_from_spec, stable_hash64)
+from multiverso_tpu.shard.router import (  # noqa: F401
+    ShardLayout, ShardedClient, fetch_layout)
+from multiverso_tpu.shard.group import ShardGroup  # noqa: F401

@@ -1,0 +1,106 @@
+"""One shard of a ShardGroup — the per-process serving entrypoint.
+
+Runs as ``python -m multiverso_tpu.shard._child --spec <group.json>
+--shard <k>``: reads the group spec, builds this shard's LOCAL slice of
+every table (range kinds at span size, with ids translated by the
+router; hash kinds at global key space), serves it over TCP, and
+announces the bound endpoint via ``<base_dir>/shard<k>.endpoint``.
+
+``--standby --primary <endpoint>`` instead runs the shard's warm standby
+(:mod:`multiverso_tpu.durable.standby`): replicate the primary, take over
+its endpoint on lease expiry, announce via ``standby<k>.tookover``.
+
+``--recover`` replays this shard's WAL before serving — the per-shard
+restart-recovery path (docs/fault_tolerance.md §7, per shard).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _write_atomic(path: str, content: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(content)
+    os.replace(tmp, path)
+
+
+def _build_tables(mv, spec, shard: int):
+    """Create this shard's local tables in layout order (table ids must
+    match the manifest's on every shard)."""
+    from multiverso_tpu.shard.partition import shard_table_kwargs
+    from multiverso_tpu.tables.sparse_table import SparseWorker
+    workers = []
+    for entry in spec["tables"]:
+        kwargs, offset = shard_table_kwargs(entry, shard)
+        kind = entry["kind"]
+        if kind == "sparse":
+            worker = SparseWorker(**kwargs)
+        else:
+            worker = mv.create_table(kind, **kwargs)
+        worker._server_table.row_offset = offset
+        if int(worker.table_id) != int(entry["table_id"]):
+            mv.log.fatal("shard %d: table id %d != layout id %d",
+                         shard, worker.table_id, entry["table_id"])
+        workers.append(worker)
+    return workers
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--spec", required=True)
+    parser.add_argument("--shard", type=int, required=True)
+    parser.add_argument("--standby", action="store_true")
+    parser.add_argument("--primary", default="")
+    parser.add_argument("--recover", action="store_true")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    with open(args.spec, "r", encoding="utf-8") as f:
+        spec = json.load(f)
+    shard = int(args.shard)
+    base_dir = os.path.dirname(os.path.abspath(args.spec))
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.durable import shard_wal_dir
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    flags = dict(spec.get("flags", {}))
+    flags["ps_role"] = "server"
+    if spec.get("wal_root"):
+        suffix = "-standby" if args.standby else ""
+        flags["wal_dir"] = shard_wal_dir(spec["wal_root"], shard) + suffix
+    mv.init(**flags)
+    tables = _build_tables(mv, spec, shard)
+    server = Zoo.instance().server
+    if server is not None:
+        server.shard_id = shard  # shard identity in stall/eviction logs
+
+    if args.standby:
+        standby = mv.warm_standby(args.primary, args.primary, tables=tables)
+        _write_atomic(os.path.join(base_dir, f"standby{shard}.ready"), "ok")
+        standby.took_over.wait()
+        remote = Zoo.instance().remote_server
+        if remote is not None:
+            remote.layout_path = spec.get("layout_path", "")
+        _write_atomic(os.path.join(base_dir, f"standby{shard}.tookover"),
+                      standby.endpoint or "")
+    else:
+        if args.recover:
+            mv.durable_recover(tables)
+        endpoint = mv.serve(f"{spec.get('host', '127.0.0.1')}:{args.port}")
+        remote = Zoo.instance().remote_server
+        remote.layout_path = spec.get("layout_path", "")
+        _write_atomic(os.path.join(base_dir, f"shard{shard}.endpoint"),
+                      endpoint)
+    while True:  # killed by the group (SIGTERM) or chaos (SIGKILL)
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,191 @@
+"""ShardGroup — launch N serving processes + publish the layout manifest.
+
+The reference ran one server actor per MPI rank and the Controller
+broadcast membership; here each shard is one OS process owning its own
+dispatcher, lease table, dedup window, WAL directory, and (optionally) a
+warm standby — so a shard's failure, recovery, and failover are fully
+independent of its peers (the acceptance property the chaos tests pin).
+
+The launcher is deliberately file-based: children announce their bound
+endpoints through ``<base_dir>/shard<k>.endpoint`` files (no stdout
+parsing races), the parent then writes ``layout.json`` atomically, and
+every member serves it over the ``Control_Layout`` RPC — the manifest on
+disk doubles as the recovery record for a restarted shard.
+
+Local groups force ``JAX_PLATFORMS=cpu`` into the children (N shards
+sharing one host's accelerator would fight over it); production runs the
+same child module one-per-host with explicit ``--port`` and a shared
+``base_dir`` on network storage, or any orchestrator that can run
+``python -m multiverso_tpu.shard._child``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from multiverso_tpu import config, log
+from multiverso_tpu.shard.partition import plan_tables, validate_partitioner_flag
+from multiverso_tpu.shard.router import (LAYOUT_VERSION, ShardLayout,
+                                         ShardedClient)
+
+class ShardGroup:
+    """Start and own a local group of shard-serving child processes."""
+
+    def __init__(self, tables: Sequence[Dict[str, Any]],
+                 shards: Optional[int] = None,
+                 base_dir: Optional[str] = None,
+                 standby: bool = False,
+                 durable: Optional[bool] = None,
+                 partitioner: Optional[str] = None,
+                 flags: Optional[Dict[str, Any]] = None,
+                 host: str = "127.0.0.1") -> None:
+        if shards is None:
+            shards = int(config.get_flag("shards"))
+        if shards < 1:
+            log.fatal("ShardGroup needs shards >= 1 (pass shards= or set "
+                      "the -shards flag)")
+        self.num_shards = int(shards)
+        self.standby = bool(standby)
+        # standby replication tails the WAL — durability is implied
+        self.durable = bool(durable) if durable is not None else self.standby
+        part_flag = validate_partitioner_flag(
+            partitioner if partitioner is not None
+            else config.get_flag("shard_partitioner"))
+        self.entries = plan_tables(tables, self.num_shards, part_flag)
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="mv_shards_")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.host = host
+        self.flags = dict(flags or {})
+        self.flags.setdefault("remote_workers", 4)
+        self.layout_path = os.path.join(self.base_dir, "layout.json")
+        self.spec_path = os.path.join(self.base_dir, "group.json")
+        self.endpoints: List[str] = []
+        self.layout: Optional[ShardLayout] = None
+        self._primaries: List[subprocess.Popen] = []
+        self._standbys: List[Optional[subprocess.Popen]] = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, timeout: float = 240.0) -> "ShardGroup":
+        spec = {"version": LAYOUT_VERSION,
+                "num_shards": self.num_shards,
+                "tables": self.entries,
+                "flags": self.flags,
+                "host": self.host,
+                "wal_root": self.base_dir if self.durable else "",
+                "layout_path": self.layout_path}
+        with open(self.spec_path, "w", encoding="utf-8") as f:
+            json.dump(spec, f)
+        deadline = time.monotonic() + timeout
+        for k in range(self.num_shards):
+            self._primaries.append(self._spawn(k))
+        self.endpoints = [self._await_file(f"shard{k}.endpoint", k, deadline)
+                          for k in range(self.num_shards)]
+        manifest = {"version": LAYOUT_VERSION,
+                    "num_shards": self.num_shards,
+                    "endpoints": self.endpoints,
+                    "tables": self.entries}
+        tmp = self.layout_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self.layout_path)  # atomic publish
+        self.layout = ShardLayout(manifest)
+        if self.standby:
+            for k in range(self.num_shards):
+                self._standbys.append(
+                    self._spawn(k, standby=True,
+                                primary=self.endpoints[k]))
+            for k in range(self.num_shards):
+                self._await_file(f"standby{k}.ready", k, deadline)
+        log.info("shard group up: %d shard(s) at %s%s", self.num_shards,
+                 self.endpoints, " (+warm standbys)" if self.standby else "")
+        return self
+
+    def _spawn(self, shard: int, standby: bool = False,
+               primary: str = "") -> subprocess.Popen:
+        argv = [sys.executable, "-m", "multiverso_tpu.shard._child",
+                "--spec", self.spec_path, "--shard", str(shard)]
+        if standby:
+            argv += ["--standby", "--primary", primary]
+        env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # a local group multiplexes one host: the children run CPU tables
+        # (production shards get one accelerator-owning host each)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        role = "standby" if standby else "shard"
+        logf = open(os.path.join(self.base_dir, f"{role}{shard}.log"), "ab")
+        try:
+            return subprocess.Popen(argv, stdout=logf, stderr=logf, env=env)
+        finally:
+            logf.close()  # the child holds its own fd
+
+    def _await_file(self, name: str, shard: int, deadline: float) -> str:
+        path = os.path.join(self.base_dir, name)
+        procs = self._standbys if name.startswith("standby") else \
+            self._primaries
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                with open(path, "r", encoding="utf-8") as f:
+                    content = f.read().strip()
+                if content:
+                    return content
+            proc = procs[shard] if shard < len(procs) else None
+            if proc is not None and proc.poll() is not None:
+                log.fatal("shard child %d died during startup (rc=%s); "
+                          "see %s", shard, proc.returncode,
+                          os.path.join(self.base_dir,
+                                       name.split(".")[0] + ".log"))
+            time.sleep(0.05)
+        log.fatal("shard group startup timed out waiting for %s", name)
+
+    def connect(self, timeout: float = 30.0) -> ShardedClient:
+        """A router client over this group's layout."""
+        if self.layout is None:
+            log.fatal("ShardGroup.connect before start()")
+        return ShardedClient(self.layout, timeout=timeout)
+
+    # -- chaos / failover hooks ----------------------------------------------
+    def kill_shard(self, shard: int) -> None:
+        """SIGKILL shard ``shard``'s primary — the chaos hook. With
+        ``standby=True`` that shard's warm standby detects the lease
+        expiry and takes over the endpoint; the other shards never see
+        anything."""
+        proc = self._primaries[shard]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    def wait_failover(self, shard: int, timeout: float = 60.0) -> str:
+        """Block until shard ``shard``'s standby has taken over; returns
+        the (re-bound) service endpoint."""
+        deadline = time.monotonic() + timeout
+        return self._await_file(f"standby{shard}.tookover", shard, deadline)
+
+    def stop(self) -> None:
+        for proc in list(self._primaries) + [p for p in self._standbys
+                                             if p is not None]:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 15.0
+        for proc in list(self._primaries) + [p for p in self._standbys
+                                             if p is not None]:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        self._primaries.clear()
+        self._standbys.clear()
+
+    def __enter__(self) -> "ShardGroup":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()

@@ -1,0 +1,525 @@
+"""Client-side shard router: split Get/Add by placement, merge replies.
+
+:class:`ShardedClient` is a drop-in for
+:class:`~multiverso_tpu.runtime.remote.RemoteClient`: same
+``table()/tables()/close()`` surface, same worker-proxy classes, same
+``submit/post`` channel contract underneath. The difference is one layer —
+a :class:`_ShardChannel` that, per request, maps the touched rows/keys to
+shard ids through the table's partitioner, issues the sub-requests through
+per-shard ``RemoteClient``\\ s (each with its OWN retry/retransmit/
+reconnect state, so a slow or dead shard never blocks traffic to the
+others), and merges the partial replies into one result that is
+bit-identical to a single-server run.
+
+Split/merge are module-level pure functions (:func:`split_request`) so the
+bit-identical property is testable against real server tables without a
+socket in sight (tests/test_shard.py).
+
+Observability: every fan-out bumps ``ROUTER_FANOUT`` by the number of
+sub-requests, and each sub-request's round trip lands in a per-shard
+histogram ``ROUTER_SHARD<k>_SECONDS`` — a dead shard's failover shows up
+in ITS histogram while the others stay flat (the property the chaos test
+asserts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from multiverso_tpu import log
+from multiverso_tpu.dashboard import count, observe
+from multiverso_tpu.runtime.message import MsgType, next_msg_id
+from multiverso_tpu.shard.partition import (RangePartitioner,
+                                            partitioner_from_spec)
+from multiverso_tpu.updaters import AddOption, GetOption
+
+LAYOUT_VERSION = 1
+
+
+class ShardLayout:
+    """The shard group's layout manifest — who serves what, where.
+
+    Plain-JSON manifest (written by :class:`~multiverso_tpu.shard.group.
+    ShardGroup`, fetched by clients via the ``Control_Layout`` RPC)::
+
+        {"version": 1, "num_shards": N,
+         "endpoints": ["host:port", ...],           # one per shard
+         "tables": [{"table_id": 0, "kind": "matrix",
+                     "params": {...global ctor args...},
+                     "partitioner": {"kind": "range", ...}}, ...]}
+    """
+
+    def __init__(self, manifest: Dict[str, Any]) -> None:
+        if int(manifest.get("version", 0)) != LAYOUT_VERSION:
+            log.fatal("shard layout version %r unsupported (want %d)",
+                      manifest.get("version"), LAYOUT_VERSION)
+        self.manifest = manifest
+        self.endpoints: List[str] = list(manifest["endpoints"])
+        self.num_shards = int(manifest.get("num_shards",
+                                           len(self.endpoints)))
+        if self.num_shards != len(self.endpoints):
+            log.fatal("shard layout lists %d endpoints for %d shards",
+                      len(self.endpoints), self.num_shards)
+        self.tables: List[Dict[str, Any]] = list(manifest["tables"])
+        self._parts: Dict[int, Any] = {}
+
+    def entry(self, table_id: int) -> Dict[str, Any]:
+        for e in self.tables:
+            if int(e["table_id"]) == int(table_id):
+                return e
+        log.fatal("shard layout has no table %d (tables: %s)", table_id,
+                  [int(e["table_id"]) for e in self.tables])
+
+    def partitioner(self, table_id: int):
+        part = self._parts.get(int(table_id))
+        if part is None:
+            part = partitioner_from_spec(self.entry(table_id)["partitioner"])
+            self._parts[int(table_id)] = part
+        return part
+
+    def to_json(self) -> str:
+        return json.dumps(self.manifest)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ShardLayout":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls(json.load(f))
+
+
+def fetch_layout(endpoint: str, timeout: float = 10.0) -> ShardLayout:
+    """One-shot layout RPC: any member of a shard group answers with the
+    full manifest, so clients bootstrap from a single known endpoint (the
+    reference's Controller broadcast, pull-shaped). Like the stats probe,
+    this takes no worker slot and no lease."""
+    from multiverso_tpu.runtime.remote import control_probe
+    payload = control_probe(endpoint, MsgType.Control_Layout,
+                            MsgType.Control_Reply_Layout, timeout=timeout,
+                            what="layout")
+    return ShardLayout(payload)
+
+
+# -- split/merge (pure; the bit-identical contract lives here) ---------------
+
+
+def _as_ids(ids: Any) -> np.ndarray:
+    return np.asarray(ids).reshape(-1)
+
+
+def _split_by_owner(part, ids: np.ndarray):
+    """-> list of (shard, positions, local_ids); shards with no work are
+    omitted, positions index the caller's original order."""
+    owners = part.shard_of(ids)
+    out = []
+    for shard in range(part.num_shards):
+        mask = owners == shard
+        if not mask.any():
+            continue
+        pos = np.nonzero(mask)[0]
+        local = part.to_local(ids[pos], shard)
+        out.append((shard, pos, local.astype(ids.dtype, copy=False)))
+    return out
+
+
+def split_request(kind: str, part, msg_type: MsgType, request: Any,
+                  params: Dict[str, Any],
+                  rewrite_option: Optional[Callable[[int, Any], Any]] = None,
+                  ) -> Tuple[List[Tuple[int, Any]], Callable[[List[Any]], Any]]:
+    """Split one channel-level request into per-shard sub-requests.
+
+    Returns ``(parts, merge)``: ``parts`` is ``[(shard, sub_request),
+    ...]`` (possibly empty for an empty workload) and ``merge`` folds the
+    aligned partial replies into the single-server reply. ``params`` is
+    the table's GLOBAL layout params (used to synthesize empty results).
+    ``rewrite_option`` maps a default-stamped option envelope to the
+    shard-local worker identity.
+    """
+    opt = rewrite_option or (lambda shard, option: option)
+    if kind == "array":
+        return _split_array(part, msg_type, request, opt)
+    if kind == "matrix":
+        return _split_matrix(part, msg_type, request, params, opt)
+    if kind == "kv":
+        return _split_kv(part, msg_type, request, opt)
+    if kind == "sparse":
+        return _split_sparse(part, msg_type, request, params, opt)
+    log.fatal("router: unknown table kind %r", kind)
+
+
+def _split_array(part, msg_type, request, opt):
+    if not isinstance(part, RangePartitioner):
+        log.fatal("array tables route by range partitioner only")
+    if msg_type == MsgType.Request_Get:
+        # request IS the option (ArrayWorker.get(option)); every shard
+        # contributes its span, concatenated in shard order
+        parts = [(s, opt(s, request)) for s in range(part.num_shards)]
+        return parts, lambda rs: np.concatenate(
+            [np.asarray(r) for r in rs])
+    delta, option = request
+    flat = np.asarray(delta).reshape(-1)
+    parts = [(s, (flat[part.span(s)[0]:part.span(s)[1]], opt(s, option)))
+             for s in range(part.num_shards)]
+    return parts, lambda rs: None
+
+
+def _split_matrix(part, msg_type, request, params, opt):
+    if not isinstance(part, RangePartitioner):
+        log.fatal("matrix tables route by range partitioner only")
+    num_col = int(params["num_col"])
+    dtype = np.dtype(params.get("dtype", "<f4"))
+    if msg_type == MsgType.Request_Get:
+        row_ids, option = request
+        if row_ids is None:
+            parts = [(s, (None, opt(s, option)))
+                     for s in range(part.num_shards)]
+
+            def merge(rs):
+                if rs and isinstance(rs[0], tuple):
+                    # sparse stale-rows form: (local_ids, rows) per shard
+                    # -> global ids, concatenated (shard spans are
+                    # ascending, so the id order matches a single server's
+                    # ascending np.where scan)
+                    ids = np.concatenate(
+                        [part.to_global(np.asarray(r[0]), s)
+                         for (s, _), r in zip(parts, rs)])
+                    rows = np.concatenate([np.asarray(r[1]).reshape(
+                        -1, num_col) for r in rs])
+                    return ids.astype(np.int32, copy=False), rows
+                return np.concatenate([np.asarray(r) for r in rs])
+            return parts, merge
+        ids = _as_ids(row_ids)
+        split = _split_by_owner(part, ids)
+        parts = [(s, (local, opt(s, option))) for s, _pos, local in split]
+
+        def merge(rs):
+            first = np.asarray(rs[0])
+            out = np.empty((len(ids),) + first.shape[1:], first.dtype)
+            for (s, pos, _local), r in zip(split, rs):
+                out[pos] = np.asarray(r)
+            return out
+        if not parts:
+            return parts, lambda rs: np.zeros((0, num_col), dtype)
+        return parts, merge
+    # Add
+    row_ids, values, option = request
+    if row_ids is None:
+        vals = np.asarray(values).reshape(part.total, -1)
+        parts = [(s, (None, vals[part.span(s)[0]:part.span(s)[1]],
+                      opt(s, option)))
+                 for s in range(part.num_shards)]
+        return parts, lambda rs: None
+    ids = _as_ids(row_ids)
+    vals = np.asarray(values).reshape(len(ids), -1)
+    split = _split_by_owner(part, ids)
+    parts = [(s, (local, vals[pos], opt(s, option)))
+             for s, pos, local in split]
+    return parts, lambda rs: None
+
+
+def _split_kv(part, msg_type, request, opt):
+    if msg_type == MsgType.Request_Get:
+        keys, option = request
+        if keys is None:
+            parts = [(s, (None, opt(s, option)))
+                     for s in range(part.num_shards)]
+
+            def merge(rs):
+                out: Dict[int, Any] = {}
+                for r in rs:
+                    out.update(r)
+                return out
+            return parts, merge
+        ids = np.asarray([int(k) for k in keys], dtype=np.int64)
+        split = _split_by_owner(part, ids)
+        parts = [(s, ([int(k) for k in local], opt(s, option)))
+                 for s, _pos, local in split]
+
+        def merge(rs):
+            out: List[Any] = [None] * len(ids)
+            for (s, pos, _local), r in zip(split, rs):
+                for p, v in zip(pos, r):
+                    out[int(p)] = v
+            return out
+        if not parts:
+            return parts, lambda rs: []
+        return parts, merge
+    keys, values, option = request
+    ids = np.asarray([int(k) for k in keys], dtype=np.int64)
+    vals = list(values)
+    split = _split_by_owner(part, ids)
+    parts = [(s, ([int(k) for k in local], [vals[int(p)] for p in pos],
+                  opt(s, option)))
+             for s, pos, local in split]
+    return parts, lambda rs: None
+
+
+def _split_sparse(part, msg_type, request, params, opt):
+    width = int(params.get("width", 1))
+    dtype = np.dtype(params.get("dtype", "<f4"))
+    if msg_type == MsgType.Request_Get:
+        keys, option = request
+        if keys is None:
+            parts = [(s, (None, opt(s, option)))
+                     for s in range(part.num_shards)]
+
+            def merge(rs):
+                live = np.concatenate(
+                    [part.to_global(np.asarray(r[0], np.int64), s)
+                     for (s, _), r in zip(parts, rs)])
+                vals = np.concatenate(
+                    [np.asarray(r[1]).reshape(-1, width) for r in rs])
+                order = np.argsort(live)  # single server returns sorted keys
+                return live[order], vals[order]
+            return parts, merge
+        ids = _as_ids(keys).astype(np.int64)
+        split = _split_by_owner(part, ids)
+        parts = [(s, (local, opt(s, option))) for s, _pos, local in split]
+
+        def merge(rs):
+            first = np.asarray(rs[0])
+            out = np.zeros((len(ids),) + first.shape[1:], first.dtype)
+            for (s, pos, _local), r in zip(split, rs):
+                out[pos] = np.asarray(r)
+            return out
+        if not parts:
+            return parts, lambda rs: np.zeros((0, width), dtype)
+        return parts, merge
+    keys, values, option = request
+    ids = _as_ids(keys).astype(np.int64)
+    vals = np.asarray(values).reshape(len(ids), -1)
+    split = _split_by_owner(part, ids)
+    parts = [(s, (local, vals[pos], opt(s, option)))
+             for s, pos, local in split]
+    return parts, lambda rs: None
+
+
+def _empty_reply(kind: str, msg_type: MsgType, request: Any,
+                 params: Dict[str, Any]) -> Any:
+    """Single-server-shaped reply for a zero-part workload (empty id/key
+    batches never touch the wire)."""
+    if msg_type == MsgType.Request_Add:
+        return None
+    dtype = np.dtype(params.get("dtype", params.get("value_dtype", "<f4")))
+    if kind == "matrix":
+        return np.zeros((0, int(params["num_col"])), dtype)
+    if kind == "sparse":
+        return np.zeros((0, int(params.get("width", 1))), dtype)
+    if kind == "kv":
+        return []
+    return np.zeros(0, dtype)
+
+
+# -- fan-out completion ------------------------------------------------------
+
+
+class _MergeCompletion:
+    """Counts down the per-shard partial replies; on the last one, merges
+    and settles the caller's completion. The first failed part fails the
+    whole request (the per-shard RemoteClient already burned its own
+    retry/reconnect budget before reporting failure)."""
+
+    __slots__ = ("_completion", "_merge", "_results", "_left", "_failed",
+                 "_lock")
+
+    def __init__(self, completion, n_parts: int, merge_fn) -> None:
+        self._completion = completion
+        self._merge = merge_fn
+        self._results: List[Any] = [None] * n_parts
+        self._left = n_parts
+        self._failed = False
+        self._lock = threading.Lock()
+
+    def part(self, idx: int, shard: int) -> "_PartCompletion":
+        return _PartCompletion(self, idx, shard)
+
+    def _part_done(self, idx: int, result: Any) -> None:
+        with self._lock:
+            self._results[idx] = result
+            self._left -= 1
+            fire = self._left == 0 and not self._failed
+        if not fire:
+            return
+        try:
+            self._completion.done(self._merge(self._results))
+        except Exception as exc:  # noqa: BLE001 — a merge bug must fail the
+            # waiter, not kill the per-shard pump thread delivering the reply
+            self._completion.fail(exc)
+
+    def _part_fail(self, idx: int, error: BaseException) -> None:
+        with self._lock:
+            if self._failed:
+                return
+            self._failed = True
+        self._completion.fail(error)
+
+
+class _PartCompletion:
+    """One sub-request's completion: records the per-shard round trip in
+    ``ROUTER_SHARD<k>_SECONDS`` then reports to the merge parent."""
+
+    __slots__ = ("_parent", "_idx", "_shard", "_t0")
+
+    def __init__(self, parent: _MergeCompletion, idx: int,
+                 shard: int) -> None:
+        self._parent = parent
+        self._idx = idx
+        self._shard = shard
+        self._t0 = time.monotonic()
+
+    def done(self, result: Any) -> None:
+        observe(f"ROUTER_SHARD{self._shard}_SECONDS",
+                time.monotonic() - self._t0)
+        self._parent._part_done(self._idx, result)
+
+    def fail(self, error: BaseException) -> None:
+        observe(f"ROUTER_SHARD{self._shard}_SECONDS",
+                time.monotonic() - self._t0)
+        self._parent._part_fail(self._idx, error)
+
+
+class _ShardChannel:
+    """WorkerTable request channel that routes through the ShardedClient
+    (the sharded analog of RemoteChannel)."""
+
+    def __init__(self, client: "ShardedClient") -> None:
+        self._client = client
+
+    def worker_id(self) -> int:
+        return self._client.worker_id
+
+    def submit(self, table_id: int, msg_type: MsgType, request: Any,
+               msg_id: int, completion) -> None:
+        self._client._route(table_id, msg_type, request, completion)
+
+    def post(self, table_id: int, msg_type: MsgType) -> None:
+        self._client._post_all(table_id, msg_type)
+
+
+class ShardedClient:
+    """Off-mesh client for a shard group — RemoteClient's surface, N
+    servers underneath.
+
+    Registers one worker slot on EVERY shard (size the shards'
+    ``remote_workers`` flag for the expected client count); the option
+    envelopes riding each sub-request carry that shard's own worker id,
+    so per-worker updater state and staleness planes stay consistent
+    per shard. Per-shard fault state is exactly RemoteClient's: retries,
+    retransmits, reconnect-and-resume, and the dedup window each shard
+    keeps — one shard's failover never blocks the others' traffic.
+    """
+
+    def __init__(self, layout: Any, timeout: float = 30.0) -> None:
+        self.layout = (layout if isinstance(layout, ShardLayout)
+                       else ShardLayout(layout))
+        from multiverso_tpu.runtime.remote import RemoteClient
+        import multiverso_tpu.config as config
+        if int(config.get_flag("wire_quant_bits")) > 0:
+            log.error("wire_quant_bits is ignored through the shard "
+                      "router (error-feedback residuals are not yet "
+                      "shard-partitioned); Adds cross the wire as plain "
+                      "float32")
+        self._clients: List[RemoteClient] = []
+        try:
+            for endpoint in self.layout.endpoints:
+                self._clients.append(RemoteClient(endpoint, timeout=timeout))
+        except BaseException:
+            self.close()
+            raise
+        self.num_shards = self.layout.num_shards
+        self.worker_id = self._clients[0].worker_id
+        self.num_workers = self._clients[0].num_workers
+        self._shard_wids = [c.worker_id for c in self._clients]
+        self._channel = _ShardChannel(self)
+        # directory: global view (layout params + shard-0 extras such as
+        # num_workers / is_pipelined, which the proxies' shaping needs)
+        self.directory: List[Dict[str, Any]] = []
+        for entry in self.layout.tables:
+            table_id = int(entry["table_id"])
+            base = next((dict(s) for s in self._clients[0].directory
+                         if int(s["table_id"]) == table_id), {})
+            base.pop("row_offset", None)
+            base.update({k: v for k, v in entry["params"].items()})
+            base["table_id"] = table_id
+            base["kind"] = entry["kind"]
+            self.directory.append(base)
+
+    # -- routing -------------------------------------------------------------
+    def _rewrite_option(self, shard: int, option: Any) -> Any:
+        """Default-stamped envelopes (worker_id == this router's
+        representative id) are re-stamped with the shard-local worker id;
+        explicit/admin envelopes pass through untouched."""
+        if (isinstance(option, (AddOption, GetOption))
+                and option.worker_id == self.worker_id
+                and self._shard_wids[shard] != self.worker_id):
+            return dataclasses.replace(option,
+                                       worker_id=self._shard_wids[shard])
+        return option
+
+    def _route(self, table_id: int, msg_type: MsgType, request: Any,
+               completion) -> None:
+        entry = self.layout.entry(table_id)
+        part = self.layout.partitioner(table_id)
+        parts, merge = split_request(entry["kind"], part, msg_type, request,
+                                     entry["params"],
+                                     rewrite_option=self._rewrite_option)
+        if completion is None:
+            for shard, sub in parts:
+                self._clients[shard]._send(table_id, msg_type, sub,
+                                           next_msg_id(), None)
+            return
+        if not parts:
+            completion.done(_empty_reply(entry["kind"], msg_type, request,
+                                         entry["params"]))
+            return
+        count("ROUTER_FANOUT", len(parts))
+        mc = _MergeCompletion(completion, len(parts), merge)
+        for idx, (shard, sub) in enumerate(parts):
+            self._clients[shard]._send(table_id, msg_type, sub,
+                                       next_msg_id(), mc.part(idx, shard))
+
+    def _post_all(self, table_id: int, msg_type: MsgType) -> None:
+        """Fire-and-forget control posts (finish_train) fan to every
+        shard: each shard's clocks retire this worker independently."""
+        for client in self._clients:
+            client._send(table_id, msg_type, None, next_msg_id(), None)
+
+    # -- table proxies ---------------------------------------------------------
+    def table(self, table_id: int):
+        """Worker proxy over the GLOBAL table shape; same shaping classes
+        as RemoteClient's proxies, routed channel underneath."""
+        from multiverso_tpu.runtime import remote as remote_mod
+        spec = next((s for s in self.directory
+                     if int(s["table_id"]) == int(table_id)), None)
+        if spec is None:
+            raise KeyError(f"no sharded table with id {table_id}; "
+                           f"layout tables: {self.directory}")
+        kind = spec["kind"]
+        builders = {"array": remote_mod._RemoteArrayWorker,
+                    "matrix": remote_mod._RemoteMatrixWorker,
+                    "kv": remote_mod._RemoteKVWorker,
+                    "sparse": remote_mod._RemoteSparseWorker}
+        if kind not in builders:
+            raise KeyError(f"unknown sharded table kind {kind!r}")
+        proxy = builders[kind](spec, int(table_id), self._channel)
+        if getattr(proxy, "_ef", None) is not None:
+            # quantized-ADD error feedback is whole-table residual state;
+            # splitting a compressed payload by rows is lossy — the router
+            # ships plain float32 until residuals are shard-partitioned
+            proxy._ef = None
+        return proxy
+
+    def tables(self) -> List[Any]:
+        return [self.table(s["table_id"]) for s in self.directory]
+
+    # -- lifecycle -------------------------------------------------------------
+    def close(self) -> None:
+        for client in self._clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — best-effort fan-out close
+                pass

@@ -166,6 +166,13 @@ class ServerTable:
 
     def __init__(self) -> None:
         self.table_id: int = -1
+        # Global position of this table's first row/element/key when it is
+        # one shard of a range-partitioned table (shard/partition.py): the
+        # member serves SHARD-LOCAL ids in [0, local size) — the router
+        # translates — and advertises the offset in its remote directory
+        # so clients and operators can see which span this member owns.
+        # 0 = unsharded (or the first shard).
+        self.row_offset: int = 0
         self._replicate = None  # lazy replicate-jit for multihost host reads
         # (scalars tuple, worker) -> device constants, LRU-bounded. A
         # repeated AddOption envelope (fixed-lr hot paths) hits the cache

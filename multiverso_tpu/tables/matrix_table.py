@@ -225,6 +225,7 @@ class MatrixServer(ServerTable):
             touched: Optional[np.ndarray] = None
         else:
             row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+            self._check_row_range(row_ids, "add")
             values = np.asarray(values, dtype=self.dtype).reshape(-1, self.num_col)
             if len(row_ids) != len(values):
                 log.fatal("Matrix.add: %d ids but %d value rows", len(row_ids), len(values))
@@ -274,6 +275,19 @@ class MatrixServer(ServerTable):
             with self._std_lock:
                 live = row_ids[row_ids < self.num_row]
                 self._up_to_date[:, live] = False
+
+    def _check_row_range(self, row_ids: np.ndarray, op: str) -> None:
+        """Host-path ids must be in [0, num_row). Worker proxies already
+        guard this, so only a routing bug (e.g. a shard router sending
+        GLOBAL ids to a span-local member) reaches here — and it must die
+        loudly: jax's clamping gather/scatter would otherwise silently
+        misdirect the rows to the last local row."""
+        if row_ids.size and (int(row_ids.min()) < 0
+                             or int(row_ids.max()) >= self.num_row):
+            log.fatal("Matrix.%s: row id out of range [0, %d) (offset %d "
+                      "of the global table) — sharded routers must send "
+                      "shard-local ids (docs/sharding.md)", op,
+                      self.num_row, self.row_offset)
 
     def _resolve_named(self, request):
         """Rehydrate a named transaction descriptor into the live form:
@@ -353,6 +367,10 @@ class MatrixServer(ServerTable):
             out = self.updater.access(self.data)
             return self._host_read(out)[: self.num_row, : self.num_col]
         row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+        if not device_out:
+            # device gets may carry sentinel-aimed pad ids (the compact
+            # training space contract); host/wire gets may not
+            self._check_row_range(row_ids, "get")
         ids_p, _, n = self._bucket_ids(row_ids, None, ensure_pad=device_out)
         gathered = self._gather(self.data, ids_p)
         if self.is_sparse and self._is_worker(option):

@@ -1,0 +1,426 @@
+"""Sharded serving tier: partitioners, the router's bit-identical
+split/merge contract against real server tables, a live 2-shard group
+over real sockets (round-trip + layout RPC + merged stats), and the
+one-shard-down failover property (zero acknowledged Adds lost, the other
+shard's traffic untouched). See docs/sharding.md."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.runtime.message import MsgType
+from multiverso_tpu.shard.partition import (HashPartitioner,
+                                            RangePartitioner,
+                                            make_partitioner,
+                                            parse_shard_endpoints,
+                                            partitioner_from_spec,
+                                            plan_tables,
+                                            shard_table_kwargs,
+                                            stable_hash64,
+                                            validate_partitioner_flag)
+from multiverso_tpu.shard.router import split_request
+from multiverso_tpu.updaters import AddOption, GetOption
+
+
+# -- partitioners -------------------------------------------------------------
+
+def test_range_partitioner_spans_tile_and_spec_roundtrips():
+    p = RangePartitioner(10, 3)
+    assert p.bounds == [0, 4, 7, 10]
+    assert [p.span(s) for s in range(3)] == [(0, 4), (4, 7), (7, 10)]
+    np.testing.assert_array_equal(p.shard_of([0, 3, 4, 6, 7, 9]),
+                                  [0, 0, 1, 1, 2, 2])
+    # every id maps into its span and translates back exactly
+    ids = np.arange(10)
+    owners = p.shard_of(ids)
+    for s in range(3):
+        mine = ids[owners == s]
+        local = p.to_local(mine, s)
+        assert local.min() >= 0 and local.max() < p.local_size(s)
+        np.testing.assert_array_equal(p.to_global(local, s), mine)
+    q = partitioner_from_spec(p.to_spec())
+    assert isinstance(q, RangePartitioner) and q.bounds == p.bounds
+
+
+def test_stable_hash_is_process_stable_golden():
+    """The shard map must survive restarts: splitmix64 golden values (any
+    change here silently reshuffles every hash-sharded table)."""
+    np.testing.assert_array_equal(
+        stable_hash64([0, 1, 2]),
+        np.array([16294208416658607535, 10451216379200822465,
+                  10905525725756348110], np.uint64))
+    np.testing.assert_array_equal(
+        HashPartitioner(4).shard_of(np.arange(20)),
+        [3, 1, 2, 1, 2, 2, 0, 3, 2, 0, 2, 1, 3, 3, 2, 1, 3, 3, 2, 0])
+    spec = HashPartitioner(4).to_spec()
+    assert partitioner_from_spec(spec).num_shards == 4
+
+
+def test_shard_config_hygiene_fails_fast():
+    """Unknown -shard_* values die loudly with the accepted set in the
+    message instead of silently defaulting."""
+    with pytest.raises(mv.log.FatalError, match="range|hash"):
+        make_partitioner("zipf", 2, total=10)
+    with pytest.raises(mv.log.FatalError, match="auto|range|hash"):
+        validate_partitioner_flag("bogus")
+    with pytest.raises(mv.log.FatalError, match="host:port"):
+        parse_shard_endpoints("localhost,127.0.0.1:x")
+    with pytest.raises(mv.log.FatalError, match="empty"):
+        parse_shard_endpoints("")
+    assert parse_shard_endpoints("10.0.0.1:5550, 10.0.0.2:5550") == [
+        "10.0.0.1:5550", "10.0.0.2:5550"]
+    # partitioner x table-kind compatibility is validated, not defaulted
+    with pytest.raises(mv.log.FatalError, match="span-positional"):
+        plan_tables([{"kind": "matrix", "num_row": 8, "num_col": 2}], 2,
+                    partitioner_flag="hash")
+    with pytest.raises(mv.log.FatalError, match="unbounded"):
+        plan_tables([{"kind": "kv"}], 2, partitioner_flag="range")
+    # sparse follows the flag; range shards shrink the key space
+    entries = plan_tables([{"kind": "sparse", "key_space": 100, "width": 2}],
+                          4, partitioner_flag="range")
+    kwargs, offset = shard_table_kwargs(entries[0], 2)
+    assert kwargs["key_space"] == 25 and offset == 50
+
+
+# -- bit-identical split/merge against real server tables --------------------
+# The property the router promises: a workload split across shard-local
+# server tables and merged client-side equals the same workload against ONE
+# global server table, bit for bit. Driven at the channel level (requests in,
+# process_add/process_get out) so no sockets blur the comparison.
+
+
+def _run_split(kind, part, servers, msg_type, request, params):
+    parts, merge = split_request(kind, part, msg_type, request, params)
+    results = []
+    for shard, sub in parts:
+        if msg_type == MsgType.Request_Get:
+            results.append(servers[shard].process_get(sub))
+        else:
+            results.append(servers[shard].process_add(sub))
+    if msg_type == MsgType.Request_Get and not parts:
+        from multiverso_tpu.shard.router import _empty_reply
+        return _empty_reply(kind, msg_type, request, params)
+    return merge(results)
+
+
+def test_matrix_range_split_bit_identical(mv_env):
+    from multiverso_tpu.tables.matrix_table import MatrixServer
+    rows, cols, shards = 37, 5, 3
+    part = RangePartitioner(rows, shards)
+    whole = MatrixServer(rows, cols, np.float32)
+    locals_ = [MatrixServer(part.local_size(s), cols, np.float32)
+               for s in range(shards)]
+    params = {"num_row": rows, "num_col": cols, "dtype": "<f4"}
+    rng = np.random.default_rng(7)
+    opt = AddOption(worker_id=0)
+    for round_ in range(6):
+        n = int(rng.integers(1, 12))
+        ids = rng.choice(rows, n, replace=False).astype(np.int32)
+        vals = rng.standard_normal((n, cols)).astype(np.float32)
+        whole.process_add((ids, vals, opt))
+        _run_split("matrix", part, locals_, MsgType.Request_Add,
+                   (ids, vals, opt), params)
+        probe = rng.choice(rows, int(rng.integers(1, 10)),
+                           replace=False).astype(np.int32)
+        expect = whole.process_get((probe, GetOption(0)))
+        got = _run_split("matrix", part, locals_, MsgType.Request_Get,
+                         (probe, GetOption(0)), params)
+        np.testing.assert_array_equal(got, expect, err_msg=f"round {round_}")
+    # duplicate ids: integer-valued floats sidestep fp association order
+    dup_ids = np.array([3, 11, 3, 36, 11, 3], np.int32)
+    dup_vals = np.arange(6 * cols, dtype=np.float32).reshape(6, cols)
+    whole.process_add((dup_ids, dup_vals, opt))
+    _run_split("matrix", part, locals_, MsgType.Request_Add,
+               (dup_ids, dup_vals, opt), params)
+    # whole-table add + whole-table get
+    dense = np.ones((rows, cols), np.float32)
+    whole.process_add((None, dense, opt))
+    _run_split("matrix", part, locals_, MsgType.Request_Add,
+               (None, dense, opt), params)
+    np.testing.assert_array_equal(
+        _run_split("matrix", part, locals_, MsgType.Request_Get,
+                   (None, GetOption(0)), params),
+        whole.process_get((None, GetOption(0))))
+    # empty batch never touches a shard
+    parts, _merge = split_request("matrix", part, MsgType.Request_Get,
+                                  (np.zeros(0, np.int32), GetOption(0)),
+                                  params)
+    assert parts == []
+    empty = _run_split("matrix", part, locals_, MsgType.Request_Get,
+                       (np.zeros(0, np.int32), GetOption(0)), params)
+    assert empty.shape == (0, cols)
+
+
+def test_matrix_sparse_staleness_split_matches(mv_env):
+    """is_sparse whole-table gets return (stale_ids, rows) per shard; the
+    merged global view must equal a single server's stale set exactly
+    (same ids, same order, same rows)."""
+    from multiverso_tpu.tables.matrix_table import MatrixServer
+    rows, cols, shards = 24, 3, 3
+    part = RangePartitioner(rows, shards)
+    whole = MatrixServer(rows, cols, np.float32, is_sparse=True,
+                         num_workers=2)
+    locals_ = [MatrixServer(part.local_size(s), cols, np.float32,
+                            is_sparse=True, num_workers=2)
+               for s in range(shards)]
+    params = {"num_row": rows, "num_col": cols, "dtype": "<f4"}
+    opt, get0 = AddOption(worker_id=0), GetOption(worker_id=0)
+
+    def compare():
+        ids_w, rows_w = whole.process_get((None, get0))
+        ids_s, rows_s = _run_split("matrix", part, locals_,
+                                   MsgType.Request_Get, (None, get0),
+                                   params)
+        np.testing.assert_array_equal(ids_s, ids_w)
+        np.testing.assert_array_equal(rows_s, rows_w)
+
+    compare()  # everything stale on first touch
+    touched = np.array([5, 9, 20], np.int32)
+    vals = np.ones((3, cols), np.float32)
+    whole.process_add((touched, vals, opt))
+    _run_split("matrix", part, locals_, MsgType.Request_Add,
+               (touched, vals, opt), params)
+    compare()  # only the touched rows come back
+    compare()  # and then nothing
+
+
+def test_array_range_split_bit_identical(mv_env):
+    from multiverso_tpu.tables.array_table import ArrayServer
+    size, shards = 23, 4
+    part = RangePartitioner(size, shards)
+    whole = ArrayServer(size, np.float32)
+    locals_ = [ArrayServer(part.local_size(s), np.float32)
+               for s in range(shards)]
+    params = {"size": size, "dtype": "<f4"}
+    rng = np.random.default_rng(3)
+    opt = AddOption(worker_id=0)
+    for _ in range(5):
+        delta = rng.standard_normal(size).astype(np.float32)
+        whole.process_add((delta, opt))
+        _run_split("array", part, locals_, MsgType.Request_Add,
+                   (delta, opt), params)
+        np.testing.assert_array_equal(
+            _run_split("array", part, locals_, MsgType.Request_Get,
+                       GetOption(0), params),
+            whole.process_get(GetOption(0)))
+
+
+@pytest.mark.parametrize("part_kind", ["hash", "range"])
+def test_sparse_split_bit_identical(mv_env, part_kind):
+    from multiverso_tpu.tables.sparse_table import SparseServer
+    key_space, width, shards = 997, 3, 3
+    part = make_partitioner(part_kind, shards, total=key_space)
+    whole = SparseServer(key_space, width)
+    locals_ = [SparseServer(part.local_size(s) if part_kind == "range"
+                            else key_space, width) for s in range(shards)]
+    params = {"key_space": key_space, "width": width, "dtype": "<f4"}
+    rng = np.random.default_rng(11)
+    for _ in range(5):
+        n = int(rng.integers(1, 20))
+        keys = rng.choice(key_space, n, replace=False).astype(np.int64)
+        vals = rng.standard_normal((n, width)).astype(np.float32)
+        whole.process_add((keys, vals, None))
+        _run_split("sparse", part, locals_, MsgType.Request_Add,
+                   (keys, vals, None), params)
+        probe = rng.choice(key_space, 15, replace=False).astype(np.int64)
+        np.testing.assert_array_equal(
+            _run_split("sparse", part, locals_, MsgType.Request_Get,
+                       (probe, None), params),
+            whole.process_get((probe, None)))
+    live_w, vals_w = whole.process_get((None, None))
+    live_s, vals_s = _run_split("sparse", part, locals_,
+                                MsgType.Request_Get, (None, None), params)
+    np.testing.assert_array_equal(live_s, live_w)
+    np.testing.assert_array_equal(vals_s, vals_w)
+
+
+def test_kv_hash_split_bit_identical(mv_env):
+    from multiverso_tpu.tables.kv_table import KVServer
+    shards = 3
+    part = HashPartitioner(shards)
+    whole = KVServer(np.int64)
+    locals_ = [KVServer(np.int64) for _ in range(shards)]
+    params = {"value_dtype": "<i8"}
+    rng = np.random.default_rng(5)
+    keyspace = [int(k) for k in rng.integers(0, 1 << 40, 30)]
+    for _ in range(4):
+        ks = [int(k) for k in rng.choice(keyspace, 8)]
+        vs = [int(v) for v in rng.integers(-5, 6, 8)]
+        whole.process_add((ks, vs, None))
+        _run_split("kv", part, locals_, MsgType.Request_Add,
+                   (ks, vs, None), params)
+        probe = [int(k) for k in rng.choice(keyspace, 10)]
+        assert _run_split("kv", part, locals_, MsgType.Request_Get,
+                          (probe, None), params) == \
+            whole.process_get((probe, None))
+    assert _run_split("kv", part, locals_, MsgType.Request_Get,
+                      (None, None), params) == \
+        whole.process_get((None, None))
+
+
+def test_matrix_server_rejects_out_of_range_ids(mv_env):
+    """Shard-local members die loudly on global ids (a router/layout bug)
+    instead of letting jax's clamping scatter corrupt the last row."""
+    from multiverso_tpu.tables.matrix_table import MatrixServer
+    server = MatrixServer(8, 2, np.float32)
+    with pytest.raises(mv.log.FatalError, match="out of range"):
+        server.process_add((np.array([8], np.int32),
+                            np.ones((1, 2), np.float32), AddOption(0)))
+    with pytest.raises(mv.log.FatalError, match="out of range"):
+        server.process_get((np.array([11], np.int32), GetOption(0)))
+
+
+# -- live shard group over real sockets ---------------------------------------
+
+GROUP_FLAGS = {"remote_workers": 4, "heartbeat_seconds": 0.2,
+               "lease_seconds": 1.5, "request_retry_seconds": 1.0,
+               "reconnect_deadline_seconds": 30.0}
+
+
+def test_shard_group_round_trip_all_kinds():
+    """A 2-shard group serves every table kind through the router; results
+    match a host-side model exactly; the layout RPC bootstraps a second
+    client from one endpoint; merged stats see both shards."""
+    from multiverso_tpu.shard.group import ShardGroup
+    tables = [{"kind": "array", "size": 16},
+              {"kind": "matrix", "num_row": 32, "num_col": 4},
+              {"kind": "kv", "value_dtype": "<i8"},
+              {"kind": "sparse", "key_space": 1000, "width": 2},
+              {"kind": "matrix", "num_row": 12, "num_col": 2,
+               "is_sparse": True}]
+    with ShardGroup(tables, shards=2, flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        arr, mat, kv, sp, smat = client.tables()
+
+        arr.add(np.arange(16, dtype=np.float32))
+        np.testing.assert_array_equal(arr.get(),
+                                      np.arange(16, dtype=np.float32))
+
+        model = np.zeros((32, 4), np.float32)
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            ids = rng.choice(32, 6, replace=False).astype(np.int32)
+            vals = rng.standard_normal((6, 4)).astype(np.float32)
+            mat.add(vals, row_ids=ids)
+            model[ids] += vals
+        np.testing.assert_array_equal(mat.get(), model)
+        probe = np.array([0, 31, 16, 15], np.int32)
+        np.testing.assert_array_equal(mat.get(probe), model[probe])
+
+        kv.add([5, 77, 123456], [2, 3, 4])
+        kv.add(5, 1)
+        assert kv.get([5, 77, 123456]) == [3, 3, 4]
+        assert kv.get() == {5: 3, 77: 3, 123456: 4}
+
+        sp.add([10, 999, 500], np.ones((3, 2), np.float32))
+        np.testing.assert_array_equal(
+            sp.get([10, 999, 500, 2]),
+            np.array([[1, 1], [1, 1], [1, 1], [0, 0]], np.float32))
+        live, vals = sp.get()
+        np.testing.assert_array_equal(live, [10, 500, 999])
+
+        # sparse-staleness matrix across the wire: the second whole get
+        # reflects only the rows invalidated since (both shards' stale
+        # sets merged into the proxy's global cache)
+        assert smat.is_sparse
+        np.testing.assert_array_equal(smat.get(), np.zeros((12, 2)))
+        smat.add(np.ones((2, 2), np.float32),
+                 row_ids=np.array([2, 9], np.int32))  # one row per shard
+        second = smat.get()
+        np.testing.assert_array_equal(second[[2, 9]], np.ones((2, 2)))
+        np.testing.assert_array_equal(second[0], np.zeros(2))
+
+        # router telemetry: fan-outs counted, both shards' histograms fed
+        assert Dashboard.counter_value("ROUTER_FANOUT") > 0
+        assert Dashboard.histogram("ROUTER_SHARD0_SECONDS").count > 0
+        assert Dashboard.histogram("ROUTER_SHARD1_SECONDS").count > 0
+
+        # bootstrap from ONE member via the Control_Layout RPC
+        client2 = mv.shard_connect(group.endpoints[1])
+        np.testing.assert_array_equal(client2.table(1).get(probe),
+                                      model[probe])
+
+        # merged stats: counters sum across members, per-shard sub-views
+        merged = mv.stats_all(group)
+        assert len(merged.shards) == 2
+        per_shard_adds = [s.histogram("SERVER_PROCESS_ADD_MSG").count
+                          for s in merged.shards]
+        assert all(c > 0 for c in per_shard_adds)
+        assert (merged.histogram("SERVER_PROCESS_ADD_MSG").count
+                == sum(per_shard_adds))
+
+        client.close()
+        client2.close()
+
+
+def test_shard_group_failover_zero_loss_other_shards_unaffected():
+    """ChaosNet-grade failure drill: SIGKILL shard 0's primary mid-
+    training. The warm standby takes over shard 0's endpoint (lease
+    eviction path), traffic to shard 1 keeps flowing at normal latency
+    throughout, and the final table equals the host model — zero
+    acknowledged Adds lost."""
+    from multiverso_tpu.shard.group import ShardGroup
+    tables = [{"kind": "matrix", "num_row": 16, "num_col": 2}]
+    with ShardGroup(tables, shards=2, standby=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=240)
+        client = group.connect()
+        mat = client.table(0)
+        model = np.zeros((16, 2), np.float32)
+        for i in range(10):  # shard 0 owns rows 0-7, shard 1 owns 8-15
+            ids = np.array([i % 8, 8 + i % 8], np.int32)
+            mat.add(np.ones((2, 2), np.float32), row_ids=ids)
+            model[ids] += 1.0
+
+        group.kill_shard(0)
+        # shard-1-only traffic during shard 0's failover window: must not
+        # block on shard 0's reconnect (per-shard client state) — each Add
+        # completes in ordinary request time, far under the failover span
+        latencies = []
+        for i in range(6):
+            ids = np.array([8 + i % 8], np.int32)
+            t0 = time.monotonic()
+            mat.add(np.ones((1, 2), np.float32), row_ids=ids)
+            latencies.append(time.monotonic() - t0)
+            model[ids] += 1.0
+        # ordinary request time (ms) — an order of magnitude under the
+        # lease window and 15x under the reconnect deadline a blocked
+        # router would have waited out; generous for loaded 1-CPU CI
+        assert max(latencies) < 2.0, latencies
+
+        endpoint = group.wait_failover(0, timeout=90)
+        assert endpoint == group.endpoints[0]  # same service endpoint
+        for i in range(4):  # spanning adds resume through reconnect+dedup
+            ids = np.array([i, 8 + i], np.int32)
+            mat.add(np.ones((2, 2), np.float32), row_ids=ids)
+            model[ids] += 1.0
+        np.testing.assert_array_equal(mat.get(), model)
+
+        # only shard 0 walked the failover path; shard 1's latency
+        # histogram never saw the event — its max stays far under the
+        # lease/reconnect windows a blocked server would have eaten
+        # (the bound leaves room for shard 1's first-Add jit compile
+        # on a loaded 1-CPU CI box, which the histogram also records)
+        merged = mv.stats_all(group)
+        assert merged.shards[0].counter("FAILOVERS") == 1
+        assert merged.shards[1].counter("FAILOVERS") == 0
+        shard1_add = merged.shards[1].histogram("SERVER_PROCESS_ADD_MSG")
+        assert shard1_add.count > 0 and shard1_add.max < 5.0
+        client.close()
+
+
+def test_layout_rpc_refused_by_non_member():
+    """Asking a plain (unsharded) server for a shard layout is a clean
+    refusal, not a hang or a bogus manifest."""
+    from multiverso_tpu.shard.router import fetch_layout
+    mv.init(remote_workers=1)
+    mv.create_table("array", 4, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    with pytest.raises(RuntimeError, match="not a shard-group member"):
+        fetch_layout(endpoint, timeout=10.0)
+    mv.shutdown()
