@@ -375,6 +375,182 @@ def bench_wire_compression(rows=1024, cols=128, nonzero_rows=0.1):
     return round(delta.nbytes / compressed, 2)
 
 
+def bench_wire(n_rtt=1500, bulk_frames=256, bulk_kb=256, n_adds=2000,
+               producers=4, window=64):
+    """Wire micro-bench — the syscall/copy overhead the zero-copy
+    coalescing drain loop (runtime/net.py) attacks, with coalescing on
+    (default flags) vs the legacy per-frame sendall posture
+    (wire_coalesce_frames=0) on the SAME workloads:
+
+    - raw transport RTT (256-byte frame ping-pong, no dispatcher) and
+      one-way bulk bandwidth (256 KiB frames — where the legacy
+      ``tobytes`` copy per frame is pure loss);
+    - end-to-end KV-table Adds over a served endpoint: sync p50, plus
+      ``producers`` concurrent worker threads pushing windowed async
+      Adds through ONE client — the burst shape whose frames coalesce
+      per syscall (frames/bytes-per-syscall reported from the live
+      send-path counters)."""
+    import threading
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.config import FLAGS
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.runtime.message import Message, MsgType
+    from multiverso_tpu.runtime.net import TcpNet
+
+    def rtt_leg(coalesce):
+        FLAGS.reset()
+        mv.set_flag("wire_coalesce_frames", 64 if coalesce else 0)
+        nets = [TcpNet() for _ in range(2)]
+        eps = [net.bind(r, "127.0.0.1:0") for r, net in enumerate(nets)]
+        for net in nets:
+            net.connect(eps)
+
+        def echo():
+            while True:
+                m = nets[1].recv()
+                if m is None:
+                    return
+                r = m.create_reply()
+                r.data = [np.float32(0)]
+                nets[1].send(r)
+
+        threading.Thread(target=echo, daemon=True).start()
+        small = np.ones(64, np.float32)
+        lat = []
+        for i in range(n_rtt):
+            t0 = time.perf_counter()
+            nets[0].send(Message(src=0, dst=1, type=MsgType.Request_Add,
+                                 msg_id=i, data=[small]))
+            nets[0].recv()
+            lat.append(time.perf_counter() - t0)
+        for net in nets:
+            net.finalize()
+        return float(np.median(lat)) * 1e6
+
+    def bulk_leg(coalesce):
+        """SEND-side cost of bulk frames into a raw byte sink — where
+        the legacy path's per-frame ``tobytes`` copy is pure loss."""
+        import socket as socket_mod
+        FLAGS.reset()
+        mv.set_flag("wire_coalesce_frames", 64 if coalesce else 0)
+        listener = socket_mod.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        net = TcpNet()
+        net.rank = 0
+        net.connect([f"127.0.0.1:{listener.getsockname()[1]}"])
+        net._socket_for(0)
+        conn, _ = listener.accept()
+
+        def sink():
+            while conn.recv(1 << 20):
+                pass
+
+        threading.Thread(target=sink, daemon=True).start()
+        big = np.ones(bulk_kb * 256, np.float32)  # bulk_kb KiB payload
+        net.send_to(0, [big])  # warm
+        t0 = time.perf_counter()
+        for _ in range(bulk_frames):
+            net.send_to(0, [big])
+        net._flush_queues(timeout=120)  # everything handed to the kernel
+        dt = time.perf_counter() - t0
+        net.finalize()
+        listener.close()
+        conn.close()
+        return bulk_frames * big.nbytes / dt / 1e9
+
+    def served_leg(coalesce):
+        FLAGS.reset()
+        mv.set_flag("wire_coalesce_frames", 64 if coalesce else 0)
+        mv.set_flag("heartbeat_seconds", 0)
+        mv.init(remote_workers=2)
+        try:
+            table = mv.create_table("kv")
+            endpoint = mv.serve("127.0.0.1:0")
+            client = mv.remote_connect(endpoint)
+            rt = client.table(table.table_id)
+            keys = list(range(64))
+            vals = [1.0] * 64
+            for _ in range(4):
+                rt.add(keys, vals)
+            Dashboard.reset()
+            lat = []
+            for _ in range(300):  # one outstanding request: pure RTT
+                t0 = time.perf_counter()
+                rt.add(keys, vals)
+                lat.append(time.perf_counter() - t0)
+
+            def push(count):
+                handles = []
+                for _ in range(count):
+                    handles.append(rt.add_async(keys, vals))
+                    if len(handles) >= window:
+                        rt.wait(handles.pop(0))
+                for h in handles:
+                    rt.wait(h)
+
+            per = n_adds // producers
+            threads = [threading.Thread(target=push, args=(per,))
+                       for _ in range(producers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            syscalls = Dashboard.counter_value("SEND_SYSCALLS")
+            frames = Dashboard.counter_value("SEND_COALESCED_FRAMES")
+            sbytes = Dashboard.counter_value("SEND_COALESCED_BYTES")
+            fps = Dashboard.histogram("WIRE_FRAMES_PER_SYSCALL")
+            client.close()
+            return {
+                "p50_us": round(float(np.median(lat)) * 1e6, 1),
+                "adds_per_sec": round(per * producers / dt, 1),
+                "frames_per_syscall_p50": (round(fps.p50, 2)
+                                           if fps.count else None),
+                "frames_per_syscall": (round(frames / syscalls, 2)
+                                       if syscalls and frames else None),
+                "bytes_per_syscall": (round(sbytes / syscalls, 1)
+                                      if syscalls and sbytes else None),
+            }
+        finally:
+            mv.shutdown()
+
+    # interleaved A/B reps: the host is shared, so adjacent pairs see the
+    # same load epoch; latency takes min (noise only adds time),
+    # bandwidth/throughput take max
+    rtts, rtts_l, gbps, gbps_l = [], [], [], []
+    for _ in range(3):
+        rtts.append(rtt_leg(True))
+        rtts_l.append(rtt_leg(False))
+        gbps.append(bulk_leg(True))
+        gbps_l.append(bulk_leg(False))
+    co = served_leg(True)
+    legacy = served_leg(False)
+    co2 = served_leg(True)
+    legacy2 = served_leg(False)
+    best = max(co, co2, key=lambda r: r["adds_per_sec"])
+    best_l = max(legacy, legacy2, key=lambda r: r["adds_per_sec"])
+    return {
+        "wire_rtt_us": round(min(rtts), 1),
+        "wire_rtt_us_legacy": round(min(rtts_l), 1),
+        "wire_bulk_gbps": round(max(gbps), 3),
+        "wire_bulk_gbps_legacy": round(max(gbps_l), 3),
+        "wire_add_p50_us": min(co["p50_us"], co2["p50_us"]),
+        "wire_add_p50_us_legacy": min(legacy["p50_us"],
+                                      legacy2["p50_us"]),
+        "wire_pipelined_adds_per_sec": best["adds_per_sec"],
+        "wire_pipelined_adds_per_sec_legacy": best_l["adds_per_sec"],
+        "wire_frames_per_syscall_p50": best["frames_per_syscall_p50"],
+        "wire_frames_per_syscall": best["frames_per_syscall"],
+        "wire_bytes_per_syscall": best["bytes_per_syscall"],
+        "wire_coalesce_speedup_x": round(
+            best["adds_per_sec"]
+            / max(best_l["adds_per_sec"], 1e-9), 2),
+    }
+
+
 def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
     """ResNet ASGD cost — the shape of the reference's only PUBLISHED
     numbers (torch/lasagne ResNet-32 CIFAR ASGD,
@@ -546,15 +722,27 @@ def _multihost_child(rank: int, world: int, coord: str, ctl: str,
     if rank == world - 1:  # a FOLLOWER on multihost worlds (full hop)
         ones = np.ones((4, 8), np.float32)
         ids = np.arange(4, dtype=np.int32)
-        samples = []
+        rtts = []
+        n_pipe = 200
         with mv.worker(0):
             mat.add(ones, row_ids=ids)  # warm
-            for _ in range(100):
+            for _ in range(50):
                 t0 = time.perf_counter()
-                # sync add: full forward/replay/ack round trip
+                # stop-and-wait reference: one forward/replay/ack RTT
                 mat.add(ones, row_ids=ids)
-                samples.append(time.perf_counter() - t0)
-        print(f"MHBENCH_CTRL {np.median(samples) * 1e6:.1f}", flush=True)
+                rtts.append(time.perf_counter() - t0)
+            # windowed pipeline: up to multihost_window forwards overlap
+            # in flight; acks retire out of the reorder buffer — the
+            # per-op cost of the control plane as production clients
+            # (async trainers) actually drive it
+            t0 = time.perf_counter()
+            handles = [mat.add_async(ones, row_ids=ids)
+                       for _ in range(n_pipe)]
+            for h in handles:
+                mat.wait(h)
+            pipe_us = (time.perf_counter() - t0) / n_pipe * 1e6
+        print(f"MHBENCH_CTRL {pipe_us:.1f} {np.median(rtts) * 1e6:.1f}",
+              flush=True)
     mv.process_barrier()
     mv.shutdown()
 
@@ -581,7 +769,7 @@ def bench_multihost_ps(world: int = 2, devices_per_proc: int = 4):
             me, "_mh_child", world=n, devices_per_proc=devices_per_proc,
             timeout=420,
             expect={r: (0, f"MHBENCH_RANK {r} ") for r in range(n)})
-        dts, words, ctrl_us = [], 0, None
+        dts, words, ctrl_us, rtt_us = [], 0, None, None
         for out in outs:
             for line in out.splitlines():
                 if line.startswith("MHBENCH_RANK"):
@@ -589,14 +777,16 @@ def bench_multihost_ps(world: int = 2, devices_per_proc: int = 4):
                     dts.append(float(dt))
                     words += int(w)
                 elif line.startswith("MHBENCH_CTRL"):
-                    ctrl_us = float(line.split()[1])
+                    fields = line.split()
+                    ctrl_us = float(fields[1])
+                    rtt_us = float(fields[2]) if len(fields) > 2 else None
         if len(dts) != n:
             raise RuntimeError(f"multihost bench: {len(dts)}/{n} ranks "
                                "reported")
-        return words / max(dts), ctrl_us
+        return words / max(dts), ctrl_us, rtt_us
 
-    mh_wps, ctrl_us = run_world(world)
-    single_wps, _ = run_world(1)
+    mh_wps, ctrl_us, rtt_us = run_world(world)
+    single_wps, _, _ = run_world(1)
     return {
         "multihost_ps_words_per_sec": round(mh_wps, 1),
         "multihost_world": world,
@@ -604,7 +794,10 @@ def bench_multihost_ps(world: int = 2, devices_per_proc: int = 4):
         # >1: adding a process adds throughput despite lockstep; the
         # honest denominator is the SAME workload single-process
         "multihost_scaling_x": round(mh_wps / single_wps, 2),
+        # the per-op cost through the WINDOWED pipeline (how async
+        # clients drive it); the stop-and-wait RTT is reported alongside
         "multihost_ctrl_op_us": ctrl_us,
+        "multihost_ctrl_rtt_us": rtt_us,
         # on the virtual-CPU mesh every sharded table op's collective
         # rides gRPC between localhost processes — that transport (not
         # the control plane, see multihost_ctrl_op_us) bounds scaling
@@ -761,6 +954,10 @@ def main():
     resnet, resnet_probe = run_gated(bench_resnet_asgd)
     wire_ratio = bench_wire_compression()
     try:
+        wire_bench = bench_wire()
+    except Exception as exc:  # the TCP leg must not sink the TPU figures
+        wire_bench = {"wire_bench_error": repr(exc)[:300]}
+    try:
         mh = bench_multihost_ps()
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         mh = {"multihost_error": repr(exc)[:300]}
@@ -784,6 +981,7 @@ def main():
         "matrix_add_p50_vs_target": round(50.0 / matrix["matrix_add_p50_us"], 2),
         "final_loss": round(final_loss, 4),
         "wire_sparse_compression_x": wire_ratio,
+        **wire_bench,
         **ps,
         **matrix,
         **resnet,

@@ -170,11 +170,24 @@ define_int("wire_quant_bits", 0,
            "quantize remote ADD deltas to this many bits per value "
            "(1|2|4|8) with client-side error feedback — the OneBitsFilter "
            "slot, generalized; 0 disables")
+define_int("wire_coalesce_frames", 64,
+           "max frames one vectored send syscall carries on the host wire "
+           "(runtime/net.py drain loop): frames queued while a send is in "
+           "flight flush together via socket.sendmsg. 0 = legacy per-frame "
+           "sendall (also disables the zero-copy queue)")
+define_int("wire_coalesce_bytes", 1 << 20,
+           "max payload bytes one coalesced send syscall carries; a frame "
+           "larger than this still ships alone (never split). 0 = legacy "
+           "per-frame sendall")
 define_string("multihost_endpoint", "",
               "host:port the leader (JAX process 0) binds for the multihost "
               "lockstep control plane; same value on every process")
 define_double("multihost_timeout", 120.0,
               "multihost control-plane connect/barrier timeout (seconds)")
+define_int("multihost_window", 64,
+           "max follower-origin table ops in flight to the leader before "
+           "the forwarding worker blocks (windowed pipelined control "
+           "plane; acks complete out of a reorder buffer). 0 = unbounded")
 define_string("multihost_token", "",
               "shared secret authenticating multihost control-plane "
               "handshakes (HMAC-SHA256 over the hello frames); empty gives "

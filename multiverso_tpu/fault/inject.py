@@ -189,9 +189,11 @@ class ChaosNet(TcpNet):
                            raw=lambda fr: sup._send_raw(msg.dst, fr),
                            channel=channel)
 
-    def send_via(self, conn, msg: Message, channel: int = 0) -> int:
+    def send_via(self, conn, msg: Message, channel: int = 0,
+                 flush: bool = False) -> int:
         sup = super(ChaosNet, self)
-        return self._apply(msg, lambda: sup.send_via(conn, msg, channel),
+        return self._apply(msg,
+                           lambda: sup.send_via(conn, msg, channel, flush),
                            key=("conn", id(conn)),
                            raw=lambda fr: sup._send_via_raw(conn, fr),
                            channel=channel)

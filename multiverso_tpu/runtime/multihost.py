@@ -42,11 +42,13 @@ import pickle
 import socket
 import struct
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from multiverso_tpu import config, log
-from multiverso_tpu.dashboard import monitor
+from multiverso_tpu.dashboard import gauge_set, monitor
 from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.runtime.net import _tune_socket
 
 # flags: multihost_endpoint / multihost_timeout / multihost_token (defined
 # in config.py so they exist before this module is first imported)
@@ -144,10 +146,131 @@ def _check_uniform_flags(peer_name: str, info: Dict[str, Any],
                   peer_name, detail)
 
 
-def _send_obj(sock: socket.socket, lock: threading.Lock, obj: Any) -> None:
+def _frame_obj(obj: Any) -> bytes:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    with lock:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+    return _LEN.pack(len(payload)) + payload
+
+
+class _ObjWriter:
+    """Per-socket control-plane writer: frames queue on the caller's
+    thread and a drain thread flushes everything queued while the
+    previous send was in flight in ONE syscall — the control-plane
+    analog of the wire's coalescing drain loop, so a burst of forwarded
+    ops / acks / descriptors costs one write instead of a locked
+    pickle+sendall each. The queue is byte-bounded: a wedged peer still
+    exerts the backpressure the old blocking sendall provided (which the
+    leader's outcome-retention bound relies on)."""
+
+    def __init__(self, sock: socket.socket, name: str,
+                 on_error: Optional[Callable[[BaseException], None]] = None,
+                 max_bytes: int = 2 << 20) -> None:
+        self._sock = sock
+        self._on_error = on_error
+        self._max = int(max_bytes)
+        self._cv = threading.Condition()
+        self._frames: deque = deque()
+        self._bytes = 0
+        self._closed = False
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def send(self, obj: Any) -> None:
+        self.send_raw(_frame_obj(obj))
+
+    def send_raw(self, framed: bytes) -> None:
+        """Queue one pre-framed payload (the broadcast paths pickle once
+        and hand the same bytes to every peer's writer)."""
+        with self._cv:
+            self._cv.wait_for(lambda: self._bytes < self._max
+                              or self._error is not None or self._closed)
+            if self._error is not None:
+                raise OSError(f"control-plane writer failed: "
+                              f"{self._error!r}")
+            if self._closed:
+                raise OSError("control-plane writer closed")
+            self._frames.append(framed)
+            self._bytes += len(framed)
+            self._cv.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                self._cv.wait_for(lambda: self._frames or self._closed)
+                if not self._frames:
+                    return  # closed and fully drained
+                batch = b"".join(self._frames)
+                self._frames.clear()
+            try:
+                self._sock.sendall(batch)
+            except OSError as exc:
+                with self._cv:
+                    self._error = exc
+                    self._frames.clear()
+                    self._bytes = 0
+                    self._cv.notify_all()
+                if self._on_error is not None:
+                    self._on_error(exc)
+                return
+            with self._cv:
+                self._bytes -= len(batch)
+                self._cv.notify_all()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Flush whatever is queued, then stop the drain thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+
+class _ForwardWindow:
+    """Sliding window over follower-origin table ops in flight to the
+    leader: ``acquire`` hands out the next sequence number (blocking once
+    ``multihost_window`` ops are unacknowledged), ``release`` retires one.
+    Acks arrive in the leader's COMPLETION order, not submission order
+    (async applies, BSP defers), so out-of-order releases park in the
+    acked set — the reorder buffer — until the cumulative floor reaches
+    them. ``size=0`` leaves the pipeline unbounded."""
+
+    def __init__(self, size: int) -> None:
+        self._size = int(size)
+        self._cv = threading.Condition()
+        self._next = 0
+        self._floor = 0
+        self._acked: set = set()
+        self._dead = False
+
+    def _in_flight(self) -> int:
+        return self._next - self._floor - len(self._acked)
+
+    def acquire(self) -> int:
+        with self._cv:
+            if self._size > 0:
+                self._cv.wait_for(lambda: self._dead
+                                  or self._in_flight() < self._size)
+            self._next += 1
+            gauge_set("MULTIHOST_WINDOW_INFLIGHT", self._in_flight())
+            return self._next
+
+    def release(self, seq: int) -> None:
+        with self._cv:
+            if seq <= self._floor or seq in self._acked:
+                return  # duplicate ack — already retired
+            self._acked.add(seq)
+            while (self._floor + 1) in self._acked:
+                self._acked.remove(self._floor + 1)
+                self._floor += 1
+            gauge_set("MULTIHOST_WINDOW_INFLIGHT", self._in_flight())
+            self._cv.notify_all()
+
+    def fail_all(self) -> None:
+        """Poison path: wake every blocked acquirer (their post-wake
+        poison check turns the wake into a loud fatal)."""
+        with self._cv:
+            self._dead = True
+            self._cv.notify_all()
 
 
 def _recv_obj(sock: socket.socket) -> Any:
@@ -197,13 +320,14 @@ class _ForwardCompletion:
     results are NOT shipped: the origin rank materializes the identical
     value itself when it replays the op (data rides ICI)."""
 
-    __slots__ = ("_runtime", "_origin", "_msg_id", "_is_add")
+    __slots__ = ("_runtime", "_origin", "_msg_id", "_seq", "_is_add")
 
     def __init__(self, runtime: "MultihostRuntime", origin: int,
-                 msg_id: int, is_add: bool) -> None:
+                 msg_id: int, seq: int, is_add: bool) -> None:
         self._runtime = runtime
         self._origin = origin
         self._msg_id = msg_id
+        self._seq = seq
         self._is_add = is_add
 
     def done(self, result: Any) -> None:
@@ -213,11 +337,12 @@ class _ForwardCompletion:
             log.error("multihost: dropping non-host fused add reply "
                       "(device payloads cannot cross the control plane)")
             result = None
-        self._runtime._send_to(self._origin, ("ack", self._msg_id, result))
+        self._runtime._send_to(self._origin,
+                               ("ack", self._seq, self._msg_id, result))
 
     def fail(self, error: BaseException) -> None:
-        self._runtime._send_to(self._origin,
-                               ("fail", self._msg_id, repr(error)))
+        self._runtime._send_to(
+            self._origin, ("fail", self._seq, self._msg_id, repr(error)))
 
 
 class _NullSink:
@@ -372,14 +497,19 @@ class FollowerServer:
     def send(self, msg: Message) -> None:
         completion = msg.data[-1] if msg.data else None
         request = msg.data[0] if msg.data else None
+        seq = 0
         if completion is not None:
-            self._runtime.register_pending(msg.msg_id, completion)
+            # windowed pipeline: take the next forward sequence number,
+            # blocking once multihost_window ops are unacknowledged —
+            # backpressure instead of unbounded leader-side queueing
+            seq = self._runtime.acquire_window()
+            self._runtime.register_pending(msg.msg_id, completion, seq)
         # follower hop cost (serialize + control-plane enqueue): the
         # same-named histogram gives its distribution via mv.stats/render
         with monitor("FOLLOWER_FORWARD_MSG"):
             self._runtime.send_to_leader(
-                ("req", int(msg.type), msg.table_id, msg.src, msg.msg_id,
-                 request))
+                ("req", seq, int(msg.type), msg.table_id, msg.src,
+                 msg.msg_id, request))
 
     # replay executor ------------------------------------------------------
     def execute(self, seq: int, op: str, table_id: int, origin: int,
@@ -443,12 +573,17 @@ class MultihostRuntime:
         self._timeout = float(config.get_flag("multihost_timeout"))
         self._seq = 0
         self._stopping = threading.Event()
-        # follower-side: outstanding local requests
-        self._pending: Dict[int, Any] = {}
+        # follower-side: outstanding local requests (msg_id -> (completion,
+        # forward-window seq)) plus the sliding window over forwards
+        self._pending: Dict[int, Tuple[Any, int]] = {}
         self._pending_lock = threading.Lock()
-        # leader-side: follower sockets by rank
+        self._window = _ForwardWindow(int(config.get_flag(
+            "multihost_window")))
+        # leader-side: follower sockets by rank, each with a coalescing
+        # control-plane writer (descriptors/acks batch per syscall)
         self._conns: Dict[int, socket.socket] = {}
-        self._send_locks: Dict[int, threading.Lock] = {}
+        self._writers: Dict[int, _ObjWriter] = {}
+        self._leader_writer: Optional[_ObjWriter] = None
         self._threads: List[threading.Thread] = []
         self._barrier_arrivals = 0
         self._barrier_cv = threading.Condition()
@@ -456,7 +591,6 @@ class MultihostRuntime:
         self._server: Optional[Any] = None        # leader: real Server
         self._follower: Optional[FollowerServer] = None
         self._leader_sock: Optional[socket.socket] = None
-        self._leader_lock = threading.Lock()
         # poison: set when this rank can no longer uphold the lockstep
         # invariant (leader died, a mutating replay failed) — every later
         # control-plane interaction fails LOUDLY instead of diverging
@@ -501,7 +635,7 @@ class MultihostRuntime:
                     conn, _addr = listener.accept()
                 except TimeoutError:
                     continue  # deadline check at loop top fatals
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_socket(conn)
                 # bound the hello read too: an accepted connection that
                 # never speaks must not wedge bring-up past the deadline
                 conn.settimeout(max(0.1, deadline - time.monotonic()))
@@ -526,7 +660,8 @@ class MultihostRuntime:
                 conn.sendall(_hello_frame(0, self.world))
                 conn.settimeout(None)
                 self._conns[peer] = conn
-                self._send_locks[peer] = threading.Lock()
+                self._writers[peer] = _ObjWriter(
+                    conn, name=f"mv-multihost-send-{peer}")
             listener.close()
             for peer, conn in self._conns.items():
                 t = threading.Thread(target=self._leader_recv_loop,
@@ -551,7 +686,7 @@ class MultihostRuntime:
                                   "within %.0fs", self._endpoint,
                                   self._timeout)
                     time.sleep(0.1)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(sock)
             sock.settimeout(max(1.0, deadline - time.monotonic()))
             sock.sendall(_hello_frame(self.rank, self.world))
             try:
@@ -566,6 +701,10 @@ class MultihostRuntime:
             _check_uniform_flags("the leader", ack[1], self.world)
             sock.settimeout(None)
             self._leader_sock = sock
+            self._leader_writer = _ObjWriter(
+                sock, name="mv-multihost-send-leader",
+                on_error=lambda exc: self.poison(
+                    f"cannot reach the leader (rank 0): {exc!r}"))
             # the reader thread exists from bring-up on (not only once a
             # FollowerServer attaches): MA-mode worlds have no PS but
             # still barrier and aggregate over this socket
@@ -617,22 +756,30 @@ class MultihostRuntime:
                       "device-array payloads cannot cross processes; use "
                       "the host add/get paths", exc)
         self._seq += 1
+        # pickled ONCE; each peer's coalescing writer queues the same
+        # framed bytes — descriptors emitted while a previous write is in
+        # flight flush together in one syscall per follower
         framed = _LEN.pack(len(payload)) + payload
-        for peer in sorted(self._conns):
-            sock = self._conns.get(peer)  # recv-crash handler pops
-            if sock is None:              # concurrently on its own thread
+        for peer in sorted(self._writers):
+            writer = self._writers.get(peer)  # recv-crash handler pops
+            if writer is None:                # concurrently on its thread
                 continue
             try:
-                with self._send_locks[peer]:
-                    sock.sendall(framed)
+                writer.send_raw(framed)
             except OSError as exc:
                 # a peer that missed a descriptor can never rejoin the
                 # stream — drop it loudly; its absence surfaces at the
                 # next collective (Gloo) rather than as silent corruption
                 log.error("multihost: lost follower %d mid-broadcast (%r);"
                           " dropping it from the control plane", peer, exc)
-                self._conns.pop(peer, None)
+                self._drop_follower(peer)
         return self._seq
+
+    def _drop_follower(self, peer: int) -> None:
+        self._conns.pop(peer, None)
+        writer = self._writers.pop(peer, None)
+        if writer is not None:
+            writer.close(timeout=0.1)
 
     def run_recorded(self, seq: int, op: str, fn: Any) -> Any:
         """Execute a broadcast MUTATING op on the leader and record its
@@ -652,8 +799,9 @@ class MultihostRuntime:
         with self._outcome_cv:
             self._outcomes[seq] = ok
             # Retention must exceed the deepest possible replay lag: the
-            # broadcast sendall blocks once a follower's socket buffer
-            # fills (natural backpressure), bounding in-flight
+            # per-follower writer queue is byte-bounded (2 MiB) so
+            # broadcast_exec blocks once a follower falls that far
+            # behind (natural backpressure), bounding in-flight
             # descriptors to a few thousand — 64k retained outcomes is
             # far beyond that, and an int->bool entry is tiny
             if len(self._outcomes) > 65536:
@@ -714,7 +862,7 @@ class MultihostRuntime:
                 conn.close()
             except OSError:
                 pass
-            self._conns.pop(peer, None)
+            self._drop_follower(peer)
 
     def _leader_recv_body(self, peer: int, conn: socket.socket) -> None:
         while True:
@@ -725,7 +873,7 @@ class MultihostRuntime:
                 return
             kind = obj[0]
             if kind == "req":
-                _, msg_type, table_id, src, msg_id, request = obj
+                _, fwd_seq, msg_type, table_id, src, msg_id, request = obj
                 msg_type = MsgType(msg_type)
                 data: List[Any] = []
                 if msg_type.is_server_bound and msg_type in (
@@ -741,7 +889,7 @@ class MultihostRuntime:
                                  and isinstance(request[0], str)
                                  and request[0] == "transact_named")
                     completion = _ForwardCompletion(
-                        self, peer, msg_id,
+                        self, peer, msg_id, fwd_seq,
                         is_add=(msg_type == MsgType.Request_Add
                                 and not named_txn))
                     data = [_Forwarded(peer, msg_id, request), completion]
@@ -768,11 +916,11 @@ class MultihostRuntime:
     def _send_to(self, peer: int, obj: Any) -> None:
         if peer < 0:
             return
-        sock = self._conns.get(peer)
-        if sock is None:
+        writer = self._writers.get(peer)
+        if writer is None:
             return
         try:
-            _send_obj(sock, self._send_locks[peer], obj)
+            writer.send(obj)
         except OSError as exc:
             log.error("multihost: send to %d failed: %r", peer, exc)
 
@@ -794,13 +942,14 @@ class MultihostRuntime:
             pending = list(self._pending.values())
             self._pending.clear()
         err = RuntimeError(f"multihost rank poisoned: {reason}")
-        for completion in pending:
+        for completion, _seq in pending:
             try:
                 completion.fail(err)
             except Exception:  # a dead waiter must not mask the rest
                 pass
         # wake anything blocked on the control plane; their post-wake
         # poison check turns the wake into a loud fatal
+        self._window.fail_all()
         self._agg_event.set()
         self._barrier_release.set()
 
@@ -817,33 +966,48 @@ class MultihostRuntime:
         may still be blocked inside the very collective we failed to
         join, so waiting here could deadlock the reader thread)."""
         try:
-            _send_obj(self._leader_sock, self._leader_lock,
-                      ("mut_failed", seq, err))
+            self._leader_writer.send(("mut_failed", seq, err))
         except OSError as exc:
             self.poison(f"cannot report divergence to the leader: {exc!r}")
 
     def send_to_leader(self, obj: Any) -> None:
         self._check_poison()
         try:
-            _send_obj(self._leader_sock, self._leader_lock, obj)
+            self._leader_writer.send(obj)
         except OSError as exc:
             self.poison(f"cannot reach the leader (rank 0): {exc!r}")
             self._check_poison()
 
-    def register_pending(self, msg_id: int, completion: Any) -> None:
+    def acquire_window(self) -> int:
+        """Next forward sequence number; blocks while the window is full.
+        A poison wake is loud, not a grant."""
+        seq = self._window.acquire()
+        self._check_poison()
+        return seq
+
+    def register_pending(self, msg_id: int, completion: Any,
+                         seq: int = 0) -> None:
         self._check_poison()
         with self._pending_lock:
-            self._pending[msg_id] = completion
+            self._pending[msg_id] = (completion, seq)
+
+    def _pop_pending(self, msg_id: int) -> Optional[Any]:
+        with self._pending_lock:
+            entry = self._pending.pop(msg_id, None)
+        if entry is None:
+            return None
+        completion, seq = entry
+        if seq:
+            self._window.release(seq)
+        return completion
 
     def complete_pending(self, msg_id: int, result: Any) -> None:
-        with self._pending_lock:
-            completion = self._pending.pop(msg_id, None)
+        completion = self._pop_pending(msg_id)
         if completion is not None:
             completion.done(result)
 
     def fail_pending(self, msg_id: int, exc: BaseException) -> None:
-        with self._pending_lock:
-            completion = self._pending.pop(msg_id, None)
+        completion = self._pop_pending(msg_id)
         if completion is not None:
             completion.fail(exc if isinstance(exc, Exception)
                             else RuntimeError(repr(exc)))
@@ -892,9 +1056,13 @@ class MultihostRuntime:
                 self._follower.execute(seq, op, table_id, origin, msg_id,
                                        request)
             elif kind == "ack":
-                self.complete_pending(obj[1], obj[2])
+                # ("ack", fwd_seq, msg_id, result) — completion routes by
+                # msg_id; the window retires fwd_seq through the reorder
+                # buffer (acks complete in the leader's apply order, not
+                # submission order)
+                self.complete_pending(obj[2], obj[3])
             elif kind == "fail":
-                self.fail_pending(obj[1], RuntimeError(obj[2]))
+                self.fail_pending(obj[2], RuntimeError(obj[3]))
             elif kind == "agg_result":
                 self._agg_payload = (obj[1], obj[2])
                 self._agg_event.set()
@@ -960,13 +1128,12 @@ class MultihostRuntime:
             payload = pickle.dumps(("agg_result", seq, total),
                                    protocol=pickle.HIGHEST_PROTOCOL)
             framed = _LEN.pack(len(payload)) + payload
-            for peer in sorted(self._conns):
-                sock = self._conns.get(peer)
-                if sock is None:
+            for peer in sorted(self._writers):
+                writer = self._writers.get(peer)
+                if writer is None:
                     continue
                 try:
-                    with self._send_locks[peer]:
-                        sock.sendall(framed)
+                    writer.send_raw(framed)
                 except OSError as exc:
                     log.error("multihost: agg_result to %d failed: %r",
                               peer, exc)
@@ -1014,6 +1181,11 @@ class MultihostRuntime:
         if self.rank == 0:
             for peer in sorted(self._conns):
                 self._send_to(peer, ("stop",))
+            # writers flush on close, so the stop descriptors (and any
+            # queued acks before them) actually reach the followers
+            for writer in list(self._writers.values()):
+                writer.close(timeout=5.0)
+            self._writers.clear()
             for conn in self._conns.values():
                 try:
                     conn.close()
@@ -1026,6 +1198,8 @@ class MultihostRuntime:
                     self.send_to_leader(("bye",))
                 except (OSError, log.FatalError):
                     pass  # a dying leader must not block OUR teardown
+            if self._leader_writer is not None:
+                self._leader_writer.close(timeout=5.0)
             # let the replay thread consume the leader's "stop" so no
             # lockstep descriptor is dropped mid-collective (a poisoned
             # rank's reader thread has already exited)

@@ -30,12 +30,13 @@ import struct
 import threading
 import time
 import zlib
-from typing import Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from multiverso_tpu import log
-from multiverso_tpu.dashboard import count, observe
+from multiverso_tpu import config, log
+from multiverso_tpu.dashboard import count, gauge_add, observe
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
@@ -54,14 +55,100 @@ _VERSION = 3
 _HEADER = struct.Struct("<IBBiiiiqqiqI")
 _BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
 
+# One vectored syscall carries at most this many iovec segments — well
+# under Linux's IOV_MAX (1024) so sendmsg never rejects a batch.
+_IOV_MAX_SEGS = 512
+# Batches at or below this many bytes are joined into ONE contiguous
+# buffer before the syscall: copying a few KiB is cheaper than carrying
+# dozens of iovec entries through the kernel. Zero-copy only pays once
+# the payload dwarfs the copy cost.
+_JOIN_BYTES = 1 << 16
+# Producer backpressure: a connection's outgoing queue holds at most this
+# many multiples of wire_coalesce_bytes before senders block (a dead-slow
+# peer must not buffer unbounded frames in the process).
+_QUEUE_CAP_MULT = 8
 
-def _pack_blob(arr: np.ndarray) -> Tuple[bytes, bytes]:
+
+def _tune_socket(sock: socket.socket, buf_bytes: int = 1 << 20) -> None:
+    """The ONE socket-tuning site (data plane and multihost control plane
+    both call it): latency first (TCP_NODELAY — frames are latency-bound
+    RPCs, coalescing happens above the socket, not in Nagle), then
+    throughput (SO_SNDBUF/SO_RCVBUF sized for a full coalesced batch so a
+    vectored flush lands in one kernel pass)."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, int(buf_bytes))
+        except OSError:
+            pass  # platform cap — the default sizing still applies
+
+
+def _pack_blob(arr: np.ndarray) -> Tuple[bytes, memoryview, int]:
+    """-> (head bytes, payload buffer, payload nbytes). The payload is a
+    memoryview over the array's own memory — never ``tobytes()`` — so
+    large Add/Get payloads cross the send path without a Python-side
+    copy (the memoryview keeps any ascontiguousarray temporary alive)."""
     arr = np.ascontiguousarray(arr)
     dt = arr.dtype.str.encode()[:8].ljust(8, b" ")
-    payload = arr.tobytes()
-    head = _BLOB.pack(arr.ndim, dt, len(payload)) + struct.pack(
+    head = _BLOB.pack(arr.ndim, dt, arr.nbytes) + struct.pack(
         f"<{arr.ndim}q", *arr.shape)
-    return head, payload
+    return head, memoryview(arr).cast("B"), arr.nbytes
+
+
+class _Frame:
+    """One queued outbound frame: its iovec segments plus completion
+    state (``done``/``error``) the drain loop reports back through."""
+
+    __slots__ = ("segments", "nbytes", "done", "error")
+
+    def __init__(self, segments: List[Any], nbytes: int) -> None:
+        self.segments = segments
+        self.nbytes = nbytes
+        self.done = False
+        self.error: Optional[BaseException] = None
+
+
+_send_metrics_cache = None
+
+
+def _send_metrics():
+    """Send-path metric objects, resolved ONCE: the registry's global
+    lock must not sit on the per-frame hot path (Dashboard.reset zeroes
+    objects in place, so cached references stay live)."""
+    global _send_metrics_cache
+    if _send_metrics_cache is None:
+        from multiverso_tpu.dashboard import Dashboard
+        _send_metrics_cache = (Dashboard.counter("SEND_SYSCALLS"),
+                               Dashboard.counter("SEND_COALESCED_FRAMES"),
+                               Dashboard.counter("SEND_COALESCED_BYTES"),
+                               Dashboard.histogram("WIRE_FRAMES_PER_SYSCALL"),
+                               Dashboard.gauge("SEND_QUEUE_BYTES"))
+    return _send_metrics_cache
+
+
+class _SendState:
+    """Per-socket outgoing state: the legacy per-frame send lock plus —
+    in coalescing mode — the frame deque a dedicated drain thread
+    flushes in vectored batches. ``held`` freezes the drain (tests and
+    deterministic-coalescing harnesses force a burst through it)."""
+
+    __slots__ = ("lock", "cv", "frames", "bytes", "closed", "error", "held",
+                 "draining")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        # plain Lock under the Condition: the default RLock's ownership
+        # bookkeeping is measurable on the per-frame path
+        self.cv = threading.Condition(threading.Lock())
+        self.frames: deque = deque()
+        self.bytes = 0
+        self.closed = False
+        self.error: Optional[BaseException] = None
+        self.held = False
+        # True while exactly one sender (inline caller or the drain
+        # thread) is mid-batch — the exclusivity that keeps the stream
+        # ordered without a lock held across the syscall
+        self.draining = False
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -114,13 +201,19 @@ class TcpNet:
         self._listener: Optional[socket.socket] = None
         self._conns: Dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
-        self._send_locks: Dict[int, threading.Lock] = {}
-        self._sock_locks: Dict[socket.socket, threading.Lock] = {}
+        self._send_states: Dict[socket.socket, _SendState] = {}
         self._mailbox: MtQueue = MtQueue()
         self._raw: Dict[int, MtQueue] = {}
         self._accept_thread: Optional[threading.Thread] = None
         self._accepted: list = []
         self._active = False
+        # coalescing caps are read ONCE at construction (flag changes
+        # apply to nets built after them, the per-test lifecycle);
+        # 0 on either flag = legacy per-frame sendall
+        self._coalesce_frames = int(config.get_flag("wire_coalesce_frames"))
+        self._coalesce_bytes = int(config.get_flag("wire_coalesce_bytes"))
+        self._coalesce = (self._coalesce_frames > 0
+                          and self._coalesce_bytes > 0)
 
     # -- lifecycle ----------------------------------------------------------
     def bind(self, rank: int, endpoint: str) -> str:
@@ -157,6 +250,16 @@ class TcpNet:
 
     def finalize(self) -> None:
         self._active = False
+        # flush queued frames BEFORE tearing connections down: callers
+        # that enqueued (deregister, final replies) relied on sendall
+        # semantics — give the drain loops a bounded window to empty
+        self._flush_queues(timeout=1.0)
+        with self._conn_lock:
+            states = list(self._send_states.values())
+        for st in states:
+            with st.cv:
+                st.closed = True
+                st.cv.notify_all()
         if self._listener is not None:
             # shutdown() first: close() alone leaves the accept thread
             # blocked inside accept(), and that in-flight syscall pins the
@@ -216,46 +319,271 @@ class TcpNet:
         return self.recv_from(src)
 
     def send_via(self, conn: socket.socket, msg: Message,
-                 channel: int = 0) -> int:
+                 channel: int = 0, flush: bool = False) -> int:
         """Send over an explicit connection — the reply path for peers that
         never bound a listener (remote table clients): the server answers
-        over the socket the request arrived on (``msg._conn``)."""
-        return self._send_via_raw(conn, self._frame(msg, channel))
+        over the socket the request arrived on (``msg._conn``).
+        ``flush=True`` blocks until the frame reached the kernel — the
+        ordering barrier replication needs (a WAL record must hit the
+        standby's socket before the client's ACK is even queued)."""
+        segments, nbytes = self._frame_segments(msg, channel)
+        return self._enqueue(conn, segments, nbytes, flush=flush)
 
     # -- internals ----------------------------------------------------------
-    @staticmethod
-    def _frame(msg: Message, channel: int) -> bytes:
+    def _frame_segments(self, msg: Message,
+                        channel: int) -> Tuple[List[Any], int]:
+        """Vectored frame assembly: ``[header, blob-head, blob-payload,
+        ...]`` where payloads are memoryviews over the original array
+        memory. The CRC32 runs incrementally across the payload section,
+        so the bytes on the wire are bit-identical to the legacy
+        concatenated frame without ever materializing it."""
         t0 = time.perf_counter()
-        parts = []
+        segments: List[Any] = [b""]  # header lands here once CRC is known
+        crc = 0
+        payload_len = 0
         for arr in msg.data:
-            head, payload = _pack_blob(np.asarray(arr))
-            parts.append(head)
-            parts.append(payload)
-        payload = b"".join(parts)
-        header = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src, msg.dst,
-                              int(msg.type), msg.table_id, msg.msg_id,
-                              msg.req_id, len(msg.data), len(payload),
-                              zlib.crc32(payload))
+            head, payload, blob_bytes = _pack_blob(np.asarray(arr))
+            crc = zlib.crc32(head, crc)
+            segments.append(head)
+            payload_len += len(head)
+            if blob_bytes:
+                crc = zlib.crc32(payload, crc)
+                segments.append(payload)
+                payload_len += blob_bytes
+        segments[0] = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src,
+                                   msg.dst, int(msg.type), msg.table_id,
+                                   msg.msg_id, msg.req_id, len(msg.data),
+                                   payload_len, crc)
         observe("FRAME_ENCODE_SECONDS", time.perf_counter() - t0)
-        return header + payload
+        return segments, _HEADER.size + payload_len
+
+    def _frame(self, msg: Message, channel: int) -> bytes:
+        """Contiguous frame bytes — the ChaosNet corrupt seam and golden
+        tests want the materialized form; the hot path never builds it."""
+        segments, _ = self._frame_segments(msg, channel)
+        return b"".join(segments)
 
     def _send(self, msg: Message, channel: int) -> int:
-        return self._send_raw(msg.dst, self._frame(msg, channel))
+        segments, nbytes = self._frame_segments(msg, channel)
+        return self._enqueue(self._socket_for(msg.dst), segments, nbytes)
 
     def _send_raw(self, dst: int, frame: bytes) -> int:
         """Framed-bytes send seam: ChaosNet's ``corrupt`` action flips bits
-        in an already-built frame and ships it through here."""
-        sock = self._socket_for(dst)
-        with self._send_locks.setdefault(dst, threading.Lock()):
-            sock.sendall(frame)
-        return len(frame)
+        in an already-built frame and ships it through here. Rides the
+        same per-socket queue as vectored frames, so a corrupted frame
+        coalesces with its neighbors exactly like a healthy one."""
+        return self._enqueue(self._socket_for(dst), [frame], len(frame))
 
     def _send_via_raw(self, conn: socket.socket, frame: bytes) -> int:
+        return self._enqueue(conn, [frame], len(frame))
+
+    # -- coalescing send queue ----------------------------------------------
+    def _state_for(self, sock: socket.socket) -> _SendState:
         with self._conn_lock:
-            lock = self._sock_locks.setdefault(conn, threading.Lock())
-        with lock:
-            conn.sendall(frame)
-        return len(frame)
+            st = self._send_states.get(sock)
+            if st is None:
+                st = self._send_states[sock] = _SendState()
+            return st
+
+    def _enqueue(self, sock: socket.socket, segments: List[Any],
+                 nbytes: int, flush: bool = False) -> int:
+        st = self._state_for(sock)
+        if not self._coalesce:
+            # legacy posture (wire_coalesce_* = 0): one locked sendall
+            # per frame, frame bytes materialized
+            with st.lock:
+                sock.sendall(b"".join(segments))
+            _send_metrics()[0].add(1)
+            return nbytes
+        cap = max(self._coalesce_bytes * _QUEUE_CAP_MULT, 8 << 20)
+        frame = None
+        with st.cv:
+            if st.bytes >= cap:
+                # backpressure: block while the peer is this far behind —
+                # the bound sendall's kernel buffer used to provide
+                st.cv.wait_for(lambda: st.bytes < cap or st.closed
+                               or st.error is not None)
+            if st.error is not None:
+                raise OSError(f"net: send failed earlier on this "
+                              f"connection: {st.error!r}")
+            if st.closed:
+                raise OSError("net: transport closed")
+            # fast path: the connection is idle — claim the drain token
+            # and send INLINE on this thread, allocating nothing (the
+            # single-outstanding-request case costs what a bare locked
+            # sendall did). A send already in flight is exactly the
+            # coalescing case: queue the frame for the current holder's
+            # next batch.
+            fast = not st.held and not st.draining and not st.frames
+            if fast:
+                st.draining = True
+            else:
+                frame = _Frame(segments, nbytes)
+                st.frames.append(frame)
+                st.bytes += nbytes
+                _send_metrics()[4].add(nbytes)  # SEND_QUEUE_BYTES
+                claim = not st.held and not st.draining
+                if claim:
+                    st.draining = True
+        if fast:
+            try:
+                if nbytes <= _JOIN_BYTES:
+                    sock.sendall(b"".join(segments))
+                    syscalls = 1
+                else:
+                    syscalls = self._sendmsg_all(sock, segments)
+            except OSError as exc:
+                self._fail_send_state(st, exc)
+                raise  # synchronous, exactly like the legacy sendall
+            (syscalls_c, frames_c, bytes_c, fps_hist, _g) = _send_metrics()
+            syscalls_c.add(syscalls)
+            frames_c.add(1)
+            bytes_c.add(nbytes)
+            fps_hist.observe(1 / syscalls)
+            with st.cv:
+                st.draining = False
+                # frames queued while our send was in flight: drain them
+                # (coalesced) before releasing the token
+                backlog = bool(st.frames) and not st.held \
+                    and st.error is None
+                if backlog:
+                    st.draining = True
+                st.cv.notify_all()
+            if backlog:
+                self._drain_pending(sock, st)
+            return nbytes
+        if claim:
+            self._drain_pending(sock, st)
+        if flush and not frame.done:
+            with st.cv:
+                st.cv.wait_for(lambda: frame.done
+                               or frame.error is not None)
+            if frame.error is not None:
+                raise OSError(f"net: flush failed: {frame.error!r}")
+        return nbytes
+
+    def _fail_send_state(self, st: _SendState,
+                         exc: BaseException) -> None:
+        """Sticky-fail a connection's send state: every queued frame and
+        future sender sees the error; flush/backpressure waiters wake."""
+        with st.cv:
+            st.error = exc
+            st.draining = False
+            for fr in st.frames:
+                fr.error = exc
+            st.frames.clear()
+            _send_metrics()[4].add(-st.bytes)
+            st.bytes = 0
+            st.cv.notify_all()
+
+    def _drain_pending(self, sock: socket.socket, st: _SendState) -> None:
+        """Flush the queue in vectored batches until empty — the drain
+        loop. Caller must hold the ``draining`` token; frames other
+        threads queue while a batch is in flight are picked up by the
+        re-check before the token is released, so every frame queued
+        behind an in-flight send rides ONE sendmsg syscall with its
+        neighbors (bounded by the wire_coalesce_* caps)."""
+        (syscalls_c, frames_c, bytes_c, fps_hist, queue_gauge) = \
+            _send_metrics()
+        while True:
+            batch: List[_Frame] = []
+            iov: List[Any] = []
+            nbytes = 0
+            with st.cv:
+                while st.frames:
+                    fr = st.frames[0]
+                    if batch and (len(batch) >= self._coalesce_frames
+                                  or nbytes + fr.nbytes
+                                  > self._coalesce_bytes
+                                  or len(iov) + len(fr.segments)
+                                  > _IOV_MAX_SEGS):
+                        break
+                    st.frames.popleft()
+                    batch.append(fr)
+                    iov.extend(fr.segments)
+                    nbytes += fr.nbytes
+                if not batch:
+                    st.draining = False
+                    return
+            try:
+                if nbytes <= _JOIN_BYTES:
+                    # small batches ride one contiguous buffer: copying
+                    # a few KiB beats extra iovec entries in the kernel
+                    iov = [b"".join(iov)]
+                syscalls = self._sendmsg_all(sock, iov)
+            except OSError as exc:
+                self._fail_send_state(st, exc)
+                return
+            syscalls_c.add(syscalls)
+            frames_c.add(len(batch))
+            bytes_c.add(nbytes)
+            fps_hist.observe(len(batch) / syscalls)
+            with st.cv:
+                st.bytes -= nbytes
+                queue_gauge.add(-nbytes)
+                for fr in batch:
+                    fr.done = True
+                st.cv.notify_all()
+                if not st.frames:
+                    st.draining = False
+                    return
+
+    @staticmethod
+    def _sendmsg_all(sock: socket.socket, iov: List[Any]) -> int:
+        """Send the whole iovec list; returns the syscall count. Handles
+        partial writes (resume mid-segment via memoryview slicing) and
+        chunks at _IOV_MAX_SEGS so the kernel never rejects a batch."""
+        iov = list(iov)
+        syscalls = 0
+        idx = 0
+        while idx < len(iov):
+            sent = sock.sendmsg(iov[idx:idx + _IOV_MAX_SEGS])
+            syscalls += 1
+            while idx < len(iov):
+                seg_len = len(iov[idx])
+                if sent >= seg_len:
+                    sent -= seg_len
+                    idx += 1
+                elif sent:
+                    iov[idx] = memoryview(iov[idx])[sent:]
+                    break
+                else:
+                    break
+        return max(syscalls, 1)
+
+    def _flush_queues(self, timeout: float = 1.0) -> None:
+        """Bounded wait for every outgoing queue to reach the kernel
+        (draining any backlog a hold left behind)."""
+        deadline = time.monotonic() + timeout
+        with self._conn_lock:
+            states = list(self._send_states.items())
+        for sock, st in states:
+            self._release_sends(sock)
+            with st.cv:
+                st.cv.wait_for(
+                    lambda: st.bytes == 0 or st.error is not None,
+                    timeout=max(0.0, deadline - time.monotonic()))
+
+    def _hold_sends(self, sock: socket.socket) -> None:
+        """Freeze a connection's drain (frames queue but nothing is
+        sent) — the deterministic-coalescing seam the forced-coalesce
+        tests use; ``_release_sends`` flushes the built-up burst as one
+        vectored batch."""
+        st = self._state_for(sock)
+        with st.cv:
+            st.held = True
+
+    def _release_sends(self, sock: socket.socket) -> None:
+        st = self._state_for(sock)
+        with st.cv:
+            st.held = False
+            claim = bool(st.frames) and not st.draining \
+                and st.error is None
+            if claim:
+                st.draining = True
+            st.cv.notify_all()
+        if claim:
+            self._drain_pending(sock, st)
 
     def _socket_for(self, rank: int) -> socket.socket:
         with self._conn_lock:
@@ -270,7 +598,7 @@ class TcpNet:
         # connection's recv loop would otherwise die after 30s of silence
         # and fake a peer loss
         sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _tune_socket(sock)
         with self._conn_lock:
             # keep the first established connection per peer
             existing = self._conns.get(rank)
@@ -291,7 +619,7 @@ class TcpNet:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_socket(conn)
             with self._conn_lock:
                 self._accepted.append(conn)
             threading.Thread(target=self._recv_loop, args=(conn,),
@@ -362,13 +690,26 @@ class TcpNet:
         (mid-allreduce, pending table replies) fail fast instead of hanging
         until finalize(). Only the dead peer's raw queues are poisoned."""
         with self._conn_lock:
-            self._sock_locks.pop(conn, None)
+            state = self._send_states.pop(conn, None)
             if conn in self._accepted:
                 self._accepted.remove(conn)
             for rank, sock in list(self._conns.items()):
                 if sock is conn:
                     del self._conns[rank]
                     srcs_seen = srcs_seen | {rank}
+        if state is not None:
+            # fail queued frames + wake flush/backpressure waiters; the
+            # drain thread exits on the error mark
+            err = ConnectionError("net: peer connection lost")
+            with state.cv:
+                if state.error is None:
+                    state.error = err
+                for fr in state.frames:
+                    fr.error = err
+                state.frames.clear()
+                gauge_add("SEND_QUEUE_BYTES", -state.bytes)
+                state.bytes = 0
+                state.cv.notify_all()
         try:
             conn.close()
         except OSError:

@@ -247,7 +247,12 @@ class RemoteServer:
                           table_id=table_id, msg_id=msg_id, req_id=req_id,
                           data=list(blobs))
             try:
-                self._net.send_via(conn, msg)
+                # flush: the record must reach the standby's socket before
+                # the client's ACK is even queued — with the coalescing
+                # send queues the two frames ride different connections,
+                # so the dispatcher-thread ordering alone no longer
+                # implies kernel-delivery ordering
+                self._net.send_via(conn, msg, flush=True)
             except OSError as exc:
                 log.error("remote: replication to a standby failed (%r); "
                           "dropping the subscriber — it will resubscribe "
@@ -975,6 +980,21 @@ def _make_error_feedback(shape, dtype) -> Optional[Any]:
     return ErrorFeedback(shape, bits)
 
 
+def merge_duplicate_rows(ids: np.ndarray, values: np.ndarray):
+    """Pre-aggregate duplicate row ids so every touched row's error-
+    feedback residual is read and written exactly once — duplicates would
+    otherwise share one residual read and last-write the update,
+    permanently losing part of the feedback. Shared by the per-proxy EF
+    path and the shard router's per-shard EF path."""
+    id_arr = np.asarray(ids)
+    uniq, inverse = np.unique(id_arr, return_inverse=True)
+    if len(uniq) == len(id_arr):
+        return ids, values
+    merged = np.zeros((len(uniq),) + values.shape[1:], values.dtype)
+    np.add.at(merged, inverse, values)
+    return uniq.astype(id_arr.dtype, copy=False), merged
+
+
 class _RemoteArrayWorker(ArrayWorker):
     """ArrayWorker shaping over the wire (no server construction)."""
 
@@ -1067,18 +1087,7 @@ class _RemoteMatrixWorker(MatrixWorker):
                 and request[1].dtype == np.float32):
             ids, values, option = request
             if ids is not None:
-                # pre-aggregate duplicate ids so every touched row's
-                # residual is read and written exactly once — duplicates
-                # would otherwise share one residual read and last-write
-                # the update, permanently losing part of the feedback
-                id_arr = np.asarray(ids)
-                uniq, inverse = np.unique(id_arr, return_inverse=True)
-                if len(uniq) != len(id_arr):
-                    merged = np.zeros((len(uniq),) + values.shape[1:],
-                                      values.dtype)
-                    np.add.at(merged, inverse, values)
-                    ids = uniq.astype(id_arr.dtype, copy=False)
-                    values = merged
+                ids, values = merge_duplicate_rows(ids, values)
             request = (ids, self._ef.compress(values, ids), option)
         return super()._submit(msg_type, request)
 

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from multiverso_tpu import log
+from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, observe
 from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import (RangePartitioner,
@@ -298,6 +298,67 @@ def _split_sparse(part, msg_type, request, params, opt):
     return parts, lambda rs: None
 
 
+def make_shard_error_feedback(kind: str, params: Dict[str, Any], part,
+                              bits: int) -> Optional[List[Any]]:
+    """Per-shard ErrorFeedback residual slices keyed by the layout's
+    RANGE partitioner: shard ``k``'s residual covers exactly its span, so
+    shard-local ids index it directly and the union of the slices tiles
+    the global residual a single-server client would keep. Only float32
+    array/matrix tables quantize (parity with RemoteClient's proxies);
+    returns None when quantization does not apply."""
+    if bits <= 0 or kind not in ("array", "matrix"):
+        return None
+    if np.dtype(params.get("dtype", "<f4")) != np.float32:
+        return None
+    if not isinstance(part, RangePartitioner):
+        return None  # array/matrix always range-route; belt and braces
+    from multiverso_tpu.utils.quantization import ErrorFeedback
+    if kind == "matrix":
+        return [ErrorFeedback((part.local_size(s), int(params["num_col"])),
+                              bits)
+                for s in range(part.num_shards)]
+    return [ErrorFeedback((part.local_size(s),), bits)
+            for s in range(part.num_shards)]
+
+
+def dedup_add_ids(kind: str, request: Any) -> Any:
+    """Pre-aggregate duplicate row ids in a matrix Add BEFORE the split:
+    within one shard a duplicate local id would share one residual read
+    and last-write the error feedback (same hazard the per-proxy EF path
+    guards against)."""
+    if kind != "matrix":
+        return request
+    ids, values, option = request
+    if ids is None:
+        return request
+    from multiverso_tpu.runtime.remote import merge_duplicate_rows
+    ids_arr = np.asarray(ids).reshape(-1)
+    vals = np.asarray(values, np.float32).reshape(len(ids_arr), -1)
+    ids2, vals2 = merge_duplicate_rows(ids_arr, vals)
+    return (ids2, vals2, option)
+
+
+def quantize_split_parts(kind: str, efs: List[Any],
+                         parts: List[Tuple[int, Any]]
+                         ) -> List[Tuple[int, Any]]:
+    """Compress each per-shard Add sub-request with ITS shard's residual
+    slice — quantization runs AFTER the plain-float32 split, so the
+    quantized payload routes correctly and each shard's server decodes a
+    payload shaped for its local table."""
+    out: List[Tuple[int, Any]] = []
+    for shard, sub in parts:
+        ef = efs[shard]
+        if kind == "matrix":
+            ids, values, option = sub
+            quant = ef.compress(np.asarray(values, np.float32), ids)
+            out.append((shard, (ids, quant, option)))
+        else:  # array: (span-values, option), whole-slice residual
+            values, option = sub
+            out.append((shard, (ef.compress(np.asarray(values, np.float32)),
+                                option)))
+    return out
+
+
 def _empty_reply(kind: str, msg_type: MsgType, request: Any,
                  params: Dict[str, Any]) -> Any:
     """Single-server-shaped reply for a zero-part workload (empty id/key
@@ -417,12 +478,11 @@ class ShardedClient:
         self.layout = (layout if isinstance(layout, ShardLayout)
                        else ShardLayout(layout))
         from multiverso_tpu.runtime.remote import RemoteClient
-        import multiverso_tpu.config as config
-        if int(config.get_flag("wire_quant_bits")) > 0:
-            log.error("wire_quant_bits is ignored through the shard "
-                      "router (error-feedback residuals are not yet "
-                      "shard-partitioned); Adds cross the wire as plain "
-                      "float32")
+        # wire_quant_bits routes THROUGH the shard router: residuals are
+        # kept as per-shard slices keyed by the layout's partitioner and
+        # sub-requests compress after the split (see _table_efs/_route)
+        self._efs: Dict[int, Optional[List[Any]]] = {}
+        self._ef_lock = threading.Lock()
         self._clients: List[RemoteClient] = []
         try:
             for endpoint in self.layout.endpoints:
@@ -460,13 +520,33 @@ class ShardedClient:
                                        worker_id=self._shard_wids[shard])
         return option
 
+    def _table_efs(self, table_id: int, entry: Dict[str, Any],
+                   part) -> Optional[List[Any]]:
+        """Lazily built per-shard residual slices (full-table float32 —
+        only allocate for tables that actually Add)."""
+        with self._ef_lock:
+            if table_id not in self._efs:
+                self._efs[table_id] = make_shard_error_feedback(
+                    entry["kind"], entry["params"], part,
+                    int(config.get_flag("wire_quant_bits")))
+            return self._efs[table_id]
+
     def _route(self, table_id: int, msg_type: MsgType, request: Any,
                completion) -> None:
         entry = self.layout.entry(table_id)
         part = self.layout.partitioner(table_id)
+        efs = (self._table_efs(table_id, entry, part)
+               if msg_type == MsgType.Request_Add else None)
+        if efs is not None:
+            request = dedup_add_ids(entry["kind"], request)
         parts, merge = split_request(entry["kind"], part, msg_type, request,
                                      entry["params"],
                                      rewrite_option=self._rewrite_option)
+        if efs is not None and parts:
+            # residual state mutates per compress: serialize against
+            # concurrent Adds to the same table
+            with self._ef_lock:
+                parts = quantize_split_parts(entry["kind"], efs, parts)
         if completion is None:
             for shard, sub in parts:
                 self._clients[shard]._send(table_id, msg_type, sub,
@@ -507,9 +587,11 @@ class ShardedClient:
             raise KeyError(f"unknown sharded table kind {kind!r}")
         proxy = builders[kind](spec, int(table_id), self._channel)
         if getattr(proxy, "_ef", None) is not None:
-            # quantized-ADD error feedback is whole-table residual state;
-            # splitting a compressed payload by rows is lossy — the router
-            # ships plain float32 until residuals are shard-partitioned
+            # the ROUTER owns quantization for sharded tables: it splits
+            # the plain-float32 Add first, then compresses each sub-
+            # request against that shard's residual slice (_route); a
+            # proxy-level EF here would double-quantize and hand the
+            # splitter an unsplittable payload
             proxy._ef = None
         return proxy
 

@@ -31,10 +31,23 @@ from multiverso_tpu.dashboard import Dashboard  # noqa: E402
 from multiverso_tpu.runtime.zoo import Zoo  # noqa: E402
 
 
+def _apply_env_flag_overrides():
+    """CI chaos-matrix hook: MV_WIRE_COALESCE_FRAMES/_BYTES force the
+    vectored-send caps for a whole suite run, so fault injection
+    exercises the coalescing wire path at a chosen aggressiveness (one
+    ci.yml matrix entry sets them; see .github/workflows/ci.yml)."""
+    for env, flag in (("MV_WIRE_COALESCE_FRAMES", "wire_coalesce_frames"),
+                      ("MV_WIRE_COALESCE_BYTES", "wire_coalesce_bytes")):
+        raw = os.environ.get(env)
+        if raw:
+            mv.set_flag(flag, raw)
+
+
 @pytest.fixture(autouse=True)
 def clean_runtime():
     """Reference's MultiversoEnv fixture: fresh flags + runtime per test."""
     FLAGS.reset()
+    _apply_env_flag_overrides()
     Dashboard.reset()
     yield
     try:

@@ -424,3 +424,84 @@ def test_layout_rpc_refused_by_non_member():
     with pytest.raises(RuntimeError, match="not a shard-group member"):
         fetch_layout(endpoint, timeout=10.0)
     mv.shutdown()
+
+
+# -- wire_quant_bits through the router (per-shard error feedback) ------------
+
+def test_make_shard_error_feedback_residuals_tile_partitioner():
+    from multiverso_tpu.shard.router import make_shard_error_feedback
+    part = RangePartitioner(10, 3)
+    efs = make_shard_error_feedback(
+        "matrix", {"num_col": 4, "dtype": "<f4"}, part, bits=4)
+    assert [ef.residual.shape for ef in efs] == [(4, 4), (3, 4), (3, 4)]
+    efs = make_shard_error_feedback("array", {"dtype": "<f4"},
+                                    RangePartitioner(7, 2), bits=8)
+    assert [ef.residual.shape for ef in efs] == [(4,), (3,)]
+    # only float32 array/matrix quantize (parity with RemoteClient)
+    assert make_shard_error_feedback(
+        "matrix", {"num_col": 4, "dtype": "<i4"}, part, bits=4) is None
+    assert make_shard_error_feedback("kv", {}, part, bits=4) is None
+    assert make_shard_error_feedback(
+        "matrix", {"num_col": 4, "dtype": "<f4"}, part, bits=0) is None
+
+
+def test_quantized_split_error_feedback_invariant():
+    """Router-side per-shard EF keeps the 1-bit-SGD identity: over K
+    pushes, sum(decoded deltas) + final residual == sum(true deltas)
+    EXACTLY, per shard — so nothing is ever silently lost, only deferred
+    into the next push."""
+    from multiverso_tpu.runtime import wire
+    from multiverso_tpu.shard.router import (dedup_add_ids,
+                                             make_shard_error_feedback,
+                                             quantize_split_parts,
+                                             split_request)
+    part = RangePartitioner(12, 2)
+    params = {"num_col": 3, "dtype": "<f4"}
+    efs = make_shard_error_feedback("matrix", params, part, bits=2)
+    rng = np.random.default_rng(5)
+    true_sum = np.zeros((12, 3), np.float32)
+    decoded_sum = np.zeros((12, 3), np.float32)
+    for _ in range(6):
+        ids = rng.choice(12, 8, replace=True).astype(np.int32)  # dups too
+        vals = rng.standard_normal((8, 3)).astype(np.float32)
+        np.add.at(true_sum, ids, vals)
+        request = dedup_add_ids("matrix", (ids, vals, None))
+        parts, _ = split_request("matrix", part, MsgType.Request_Add,
+                                 request, params)
+        for shard, sub in quantize_split_parts("matrix", efs, parts):
+            local_ids, quant, _opt = sub
+            # the server decodes through the wire codec, never seeing
+            # the compression
+            decoded = wire.decode(wire.encode(quant))
+            lo, hi = part.span(shard)
+            np.add.at(decoded_sum[lo:hi], np.asarray(local_ids), decoded)
+    residual = np.concatenate([ef.residual for ef in efs])
+    np.testing.assert_allclose(decoded_sum + residual, true_sum,
+                               rtol=0, atol=1e-4)
+
+
+def test_shard_group_quantized_adds_route_and_converge():
+    """Live 2-shard group with wire_quant_bits on: quantized Adds route
+    through the per-shard residual slices and the table converges to the
+    true sum within the quantization step (the PR-4 loud-ignore is
+    gone)."""
+    from multiverso_tpu.shard.group import ShardGroup
+    mv.set_flag("wire_quant_bits", 8)
+    tables = [{"kind": "matrix", "num_row": 16, "num_col": 4}]
+    with ShardGroup(tables, shards=2, flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        mat = client.table(0)
+        model = np.zeros((16, 4), np.float32)
+        rng = np.random.default_rng(0)
+        for _ in range(8):
+            ids = rng.choice(16, 6, replace=False).astype(np.int32)
+            vals = rng.uniform(-1.0, 1.0, (6, 4)).astype(np.float32)
+            mat.add(vals, row_ids=ids)
+            model[ids] += vals
+        got = np.asarray(mat.get(), np.float32)
+        # 8-bit EF: per-element error is bounded by the final residual,
+        # itself under one quantization step of the last push
+        np.testing.assert_allclose(got, model, rtol=0, atol=0.05)
+        assert np.abs(got - model).max() > 0.0 or True  # lossy by design
+        client.close()
