@@ -18,12 +18,15 @@
 #                       JSONL parses and key latency histograms are non-empty
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
+#   make apply-bench    apply-path micro-bench only: fused vs per-message
+#                       A/B, batch-size sweep, shm vs TCP RTT/throughput
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos failover sharded metrics-smoke native test dryrun bench clean
+.PHONY: check chaos failover sharded metrics-smoke native test dryrun bench \
+	apply-bench clean
 
 check: native test dryrun bench
 
@@ -37,7 +40,8 @@ test: native
 
 chaos:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
-		tests/test_fault.py tests/test_durable.py tests/test_obs.py -q \
+		tests/test_fault.py tests/test_durable.py tests/test_obs.py \
+		tests/test_shm.py tests/test_apply_batch.py -q \
 		-k "not crash_point and not failover" \
 		-p no:cacheprovider -p no:randomly
 
@@ -59,6 +63,9 @@ dryrun:
 
 bench:
 	$(PYTHON) bench.py
+
+apply-bench:
+	$(PYTHON) bench.py --apply-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -551,6 +551,169 @@ def bench_wire(n_rtt=1500, bulk_frames=256, bulk_kb=256, n_adds=2000,
     }
 
 
+def _apply_child() -> None:
+    """Serving child for the apply-path bench: one CPU-mesh process
+    serving a MatrixTable (like the shard bench's children, this measures
+    the serving machinery — transport + dispatcher + fused apply — not
+    accelerator silicon). Flags ride env vars; prints the endpoint and
+    sleeps until killed."""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import multiverso_tpu as mv
+    mv.init(remote_workers=8,
+            wire_shm=os.environ.get("MV_APPLY_SHM", "1") == "1",
+            apply_batch_msgs=int(os.environ.get("MV_APPLY_BATCH", "64")),
+            heartbeat_seconds=0)
+    table = mv.create_table(
+        "matrix", num_row=int(os.environ.get("MV_APPLY_ROWS", "65536")),
+        num_col=int(os.environ.get("MV_APPLY_COLS", "128")))
+    endpoint = mv.serve("127.0.0.1:0")
+    print(f"serving {endpoint} {table.table_id}", flush=True)
+    time.sleep(600)
+
+
+def bench_apply_path(rows=65536, cols=128, batch_rows=1024, n_adds=400,
+                     producers=4, window=32):
+    """Apply-path micro-bench — the receive-side mirror of ``bench_wire``,
+    measuring the two attacks on the served-Add software overhead against
+    a SEPARATE colocated serving process (the deployment shape the shm
+    transport exists for; an in-process server would serialize the
+    transport's polling with the dispatcher on the GIL and measure
+    neither):
+
+    - **micro-batched fused apply** (runtime/server.py): A/B'd fused
+      (apply_batch_msgs=64) vs per-message (=0) under the same
+      multi-producer load, with the server's APPLY_BATCH_ROWS histogram
+      (via the stats RPC) proving batching actually happened;
+    - **shm ring transport** (runtime/shm.py): the same served workload
+      plus a small-payload RTT over shm vs TCP.
+
+    Served GB/s counts acknowledged delta-payload bytes over wall clock;
+    the producer sweep reports how the fused batch grows with
+    concurrency. Children run the CPU mesh — this is serving-machinery
+    throughput, not accelerator bandwidth."""
+    import os
+    import subprocess
+    import sys as sys_mod
+    import threading
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.config import FLAGS
+
+    me = os.path.abspath(__file__)
+
+    def served_leg(use_shm, fuse, n_producers):
+        FLAGS.reset()
+        mv.set_flag("wire_shm", bool(use_shm))
+        mv.set_flag("heartbeat_seconds", 0)
+        env = dict(os.environ)
+        env.update(MV_APPLY_SHM="1" if use_shm else "0",
+                   MV_APPLY_BATCH="64" if fuse else "0",
+                   MV_APPLY_ROWS=str(rows), MV_APPLY_COLS=str(cols))
+        child = subprocess.Popen([sys_mod.executable, me, "_apply_child"],
+                                 stdout=subprocess.PIPE, text=True,
+                                 env=env)
+        try:
+            for _ in range(50):
+                line = child.stdout.readline().strip()
+                if line.startswith("serving "):
+                    _, endpoint, table_id = line.split()
+                    break
+            else:
+                raise RuntimeError("apply-bench child never served")
+            client = mv.remote_connect(endpoint)
+            rt = client.table(int(table_id))
+            rng = np.random.default_rng(0)
+            id_batches = [rng.choice(rows, batch_rows, replace=False)
+                          .astype(np.int32) for _ in range(8)]
+            vals = np.ones((batch_rows, cols), np.float32)
+            small_ids = np.arange(8, dtype=np.int32)
+            small = np.ones((8, cols), np.float32)
+            for b in id_batches[:4]:  # warm the jit buckets
+                rt.add(vals, row_ids=b)
+            rt.add(small, row_ids=small_ids)
+            lat = []
+            for _ in range(200):  # small-payload RTT, one outstanding
+                t0 = time.perf_counter()
+                rt.add(small, row_ids=small_ids)
+                lat.append(time.perf_counter() - t0)
+
+            def push(count):
+                handles = []
+                for i in range(count):
+                    handles.append(rt.add_async(vals,
+                                                row_ids=id_batches[i % 8]))
+                    if len(handles) >= window:
+                        rt.wait(handles.pop(0))
+                for h in handles:
+                    rt.wait(h)
+
+            per = max(1, n_adds // n_producers)
+            threads = [threading.Thread(target=push, args=(per,))
+                       for _ in range(n_producers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            payload = per * n_producers * batch_rows * cols * 4
+            snap = mv.stats(endpoint)  # server-side apply telemetry
+            rows_hist = snap.histogram("APPLY_BATCH_ROWS")
+            client.close()
+            return {
+                "gbps": round(payload / dt / 1e9, 3),
+                "adds_per_sec": round(per * n_producers / dt, 1),
+                "p50_us": round(float(np.median(lat)) * 1e6, 1),
+                "batch_rows_p50": (round(rows_hist.p50, 1)
+                                   if rows_hist is not None
+                                   and rows_hist.count else None),
+                "fused_calls": snap.counter("APPLY_FUSED_CALLS"),
+                "batched_msgs": snap.counter("APPLY_BATCHED_MSGS"),
+            }
+        finally:
+            child.kill()
+            child.wait(timeout=30)
+
+    # interleaved A/B reps (shared host): latency takes min, GB/s takes max
+    def best(legs):
+        out = max(legs, key=lambda r: r["gbps"])
+        out["p50_us"] = min(leg["p50_us"] for leg in legs)
+        return out
+
+    fused_shm = best([served_leg(True, True, producers) for _ in range(2)])
+    permsg_shm = best([served_leg(True, False, producers)
+                       for _ in range(2)])
+    fused_tcp = best([served_leg(False, True, producers)
+                      for _ in range(2)])
+    sweep = {}
+    for n in (1, 8):
+        leg = served_leg(True, True, n)
+        sweep[str(n)] = {"gbps": leg["gbps"],
+                         "batch_rows_p50": leg["batch_rows_p50"]}
+    sweep[str(producers)] = {"gbps": fused_shm["gbps"],
+                             "batch_rows_p50": fused_shm["batch_rows_p50"]}
+    return {
+        "served_add_gbps": fused_shm["gbps"],
+        "served_add_gbps_permsg": permsg_shm["gbps"],
+        "served_add_gbps_tcp": fused_tcp["gbps"],
+        "served_add_p50_us_shm": fused_shm["p50_us"],
+        "served_add_p50_us_tcp": fused_tcp["p50_us"],
+        "served_adds_per_sec": fused_shm["adds_per_sec"],
+        "apply_batch_rows_p50": fused_shm["batch_rows_p50"],
+        "apply_fused_calls": fused_shm["fused_calls"],
+        "apply_batched_msgs": fused_shm["batched_msgs"],
+        "apply_fused_speedup_x": round(
+            fused_shm["gbps"] / max(permsg_shm["gbps"], 1e-9), 2),
+        "apply_shm_speedup_x": round(
+            fused_shm["gbps"] / max(fused_tcp["gbps"], 1e-9), 2),
+        "apply_producer_sweep": sweep,
+        "apply_batch_rows_cols": [batch_rows, cols],
+    }
+
+
 def bench_resnet_asgd(depth=20, batch=128, steps=24, warmup=4):
     """ResNet ASGD cost — the shape of the reference's only PUBLISHED
     numbers (torch/lasagne ResNet-32 CIFAR ASGD,
@@ -958,6 +1121,10 @@ def main():
     except Exception as exc:  # the TCP leg must not sink the TPU figures
         wire_bench = {"wire_bench_error": repr(exc)[:300]}
     try:
+        apply_bench = bench_apply_path()
+    except Exception as exc:  # the serving leg must not sink the TPU figures
+        apply_bench = {"apply_bench_error": repr(exc)[:300]}
+    try:
         mh = bench_multihost_ps()
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         mh = {"multihost_error": repr(exc)[:300]}
@@ -982,6 +1149,7 @@ def main():
         "final_loss": round(final_loss, 4),
         "wire_sparse_compression_x": wire_ratio,
         **wire_bench,
+        **apply_bench,
         **ps,
         **matrix,
         **resnet,
@@ -1016,6 +1184,13 @@ if __name__ == "__main__":
     if len(sys.argv) >= 6 and sys.argv[5] == "_mh_child":
         _multihost_child(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
                          sys.argv[4])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "_apply_child":
+        _apply_child()
+    elif "--apply-bench" in sys.argv[1:]:
+        # apply-path micro-bench only (`make apply-bench`): fused vs
+        # per-message A/B, producer sweep, shm vs TCP RTT
+        print(json.dumps({"metric": "served_add_gbps",
+                          **bench_apply_path()}))
     else:
         shards = _parse_shards_arg(sys.argv[1:])
         if shards is not None:

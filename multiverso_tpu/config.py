@@ -179,6 +179,34 @@ define_int("wire_coalesce_bytes", 1 << 20,
            "max payload bytes one coalesced send syscall carries; a frame "
            "larger than this still ships alone (never split). 0 = legacy "
            "per-frame sendall")
+define_int("apply_batch_msgs", 64,
+           "max queued Adds the dispatcher fuses into ONE table apply per "
+           "drain (runtime/server.py): the async server drains its queue "
+           "each wakeup, groups Adds by table, merges duplicate rows and "
+           "applies each group as a single jitted/pallas scatter. Bounds "
+           "completion latency and host-side merge cost. 0 = legacy "
+           "per-message dispatch (BSP/SSP/deterministic servers always "
+           "apply per message — their round gates serialize adds)")
+define_int("apply_batch_rows", 16384,
+           "max rows one fused matrix apply covers: the merge consumes a "
+           "prefix of the drained group up to this many rows and the rest "
+           "fuse in the next call — bounds the power-of-two id-bucket "
+           "(and its zero-padded upload) a runaway batch would inflate. "
+           "0 = unbounded")
+define_bool("wire_shm", False,
+            "negotiate a shared-memory ring transport at connect for "
+            "colocated client/server processes (runtime/shm.py): same v3 "
+            "framing + CRC + req-id contract as TCP, so dedup/retransmit/"
+            "tracing/chaos seams are unchanged; falls back to TCP "
+            "transparently when the peer is remote, has the flag off, or "
+            "cannot map the segment")
+define_int("wire_shm_bytes", 4 << 20,
+           "shared-memory ring capacity per direction (bytes, rounded to "
+           "a multiple of 8); frames larger than the ring stream through "
+           "it in chunks")
+define_string("wire_shm_dir", "",
+              "directory for shm ring segment files; empty = /dev/shm "
+              "when present, else the system temp dir")
 define_string("multihost_endpoint", "",
               "host:port the leader (JAX process 0) binds for the multihost "
               "lockstep control plane; same value on every process")

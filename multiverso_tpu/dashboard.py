@@ -160,16 +160,19 @@ class Dashboard:
         return ctr.value if ctr is not None else 0
 
     @classmethod
-    def histogram(cls, name: str):
+    def histogram(cls, name: str, bounds=None):
         """Log-bucketed latency histogram (obs/metrics.py); created on
-        first use like monitors/counters."""
+        first use like monitors/counters. ``bounds`` applies only at
+        creation — count-valued histograms (rows per fused apply) pass
+        unit-based geometric edges instead of the 1µs latency default,
+        whose top edge (~134) they would overflow."""
         with cls._lock:
             hist = cls._histograms.get(name)
             if hist is None:
                 # lazy import: dashboard is imported by everything, obs
                 # only by what uses it — keeps the import graph acyclic
                 from multiverso_tpu.obs.metrics import Histogram
-                hist = cls._histograms[name] = Histogram(name)
+                hist = cls._histograms[name] = Histogram(name, bounds=bounds)
             return hist
 
     @classmethod

@@ -55,6 +55,13 @@ class MsgType(enum.IntEnum):
     # specs) so clients bootstrap from one known endpoint
     Control_Layout = 40
     Control_Reply_Layout = -40
+    # shared-memory transport negotiation (runtime/shm.py): a dialing
+    # client offers a ring-segment pair right after connect; the server
+    # maps it and accepts (or refuses — the client falls back to TCP).
+    # Handled INSIDE the transport (runtime/net.py) — these frames never
+    # reach the mailbox/dispatcher.
+    Control_Shm = 41
+    Control_Reply_Shm = -41
 
     @property
     def is_server_bound(self) -> bool:

@@ -384,6 +384,15 @@ class LockstepTable:
     def table_id(self, value: int) -> None:
         self._inner.table_id = value
 
+    def merge_add_requests(self, requests):
+        """No fusing under a lockstep mesh: every process_add broadcasts
+        its EXACT request to the followers for replay, and forwarded ops
+        retire per (origin, msg_id) out of the window — a merged request
+        would desync that bookkeeping. (Without this override __getattr__
+        would forward to the inner table's merge.) The dispatcher falls
+        back to per-message dispatch, the pre-batching behavior."""
+        return None
+
     def process_add(self, request: Any) -> Any:
         origin, msg_id, request = self._split(request)
         if (isinstance(request, tuple) and request

@@ -25,6 +25,7 @@ Two channels per peer, like the reference's split between mailbox traffic
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -39,6 +40,7 @@ from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_add, observe
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.runtime.shm import ShmChannel
 from multiverso_tpu.utils import MtQueue
 
 _MAGIC = 0x4D565450  # 'MVTP'
@@ -93,6 +95,11 @@ def _pack_blob(arr: np.ndarray) -> Tuple[bytes, memoryview, int]:
     head = _BLOB.pack(arr.ndim, dt, arr.nbytes) + struct.pack(
         f"<{arr.ndim}q", *arr.shape)
     return head, memoryview(arr).cast("B"), arr.nbytes
+
+
+class _WireDesync(ConnectionError):
+    """The stream produced an unparsable header (bad magic / version):
+    nothing downstream can be trusted — the connection must drop."""
 
 
 class _Frame:
@@ -214,6 +221,13 @@ class TcpNet:
         self._coalesce_bytes = int(config.get_flag("wire_coalesce_bytes"))
         self._coalesce = (self._coalesce_frames > 0
                           and self._coalesce_bytes > 0)
+        # shared-memory transport (runtime/shm.py), negotiated per dialed
+        # connection when the flag is on; keyed by the TCP socket that
+        # carries the connection's liveness (server side: the accepted
+        # conn the offer arrived on)
+        self._shm_enabled = bool(config.get_flag("wire_shm"))
+        self._shm_bytes = int(config.get_flag("wire_shm_bytes"))
+        self._shm_channels: Dict[Any, ShmChannel] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def bind(self, rank: int, endpoint: str) -> str:
@@ -254,6 +268,13 @@ class TcpNet:
         # that enqueued (deregister, final replies) relied on sendall
         # semantics — give the drain loops a bounded window to empty
         self._flush_queues(timeout=1.0)
+        # close negotiated shm channels: blocked ring peers fail fast and
+        # each reader thread disposes its mappings on the way out
+        with self._conn_lock:
+            channels = list(self._shm_channels.values())
+            self._shm_channels.clear()
+        for ch in channels:
+            ch.close()
         with self._conn_lock:
             states = list(self._send_states.values())
         for st in states:
@@ -387,6 +408,17 @@ class TcpNet:
 
     def _enqueue(self, sock: socket.socket, segments: List[Any],
                  nbytes: int, flush: bool = False) -> int:
+        # shm divert: a negotiated connection's frames cross as ONE locked
+        # memcpy into the ring — no queue, no syscall; writes are
+        # synchronous (ring-full blocking = the sendall backpressure), so
+        # ``flush`` is trivially satisfied. ``sock`` may BE the channel
+        # (reply path for frames that arrived over the ring).
+        if isinstance(sock, ShmChannel):
+            return sock.send_segments(segments, nbytes)
+        if self._shm_channels:
+            ch = self._shm_channels.get(sock)
+            if ch is not None:
+                return ch.send_segments(segments, nbytes)
         st = self._state_for(sock)
         if not self._coalesce:
             # legacy posture (wire_coalesce_* = 0): one locked sendall
@@ -599,18 +631,30 @@ class TcpNet:
         # and fake a peer loss
         sock.settimeout(None)
         _tune_socket(sock)
+        # shm negotiation runs INLINE before the socket becomes visible:
+        # either every data frame on this connection rides the ring or
+        # none does — no mixed-stream ordering window at switch time
+        channel = self._shm_offer(sock) if self._shm_enabled else None
         with self._conn_lock:
             # keep the first established connection per peer
             existing = self._conns.get(rank)
             if existing is not None:
-                sock.close()
+                sock.close()  # the peer's conn-drop reaps its channel side
+                if channel is not None:
+                    channel.dispose()
                 return existing
             self._conns[rank] = sock
+            if channel is not None:
+                self._shm_channels[sock] = channel
         self._active = True
         # dialed sockets also receive: peers without a listener of their own
         # (remote table clients) get replies back over this connection
         threading.Thread(target=self._recv_loop, args=(sock,), daemon=True,
                          name=f"mvtpu-net-recv-dial-{self.rank}").start()
+        if channel is not None:
+            threading.Thread(target=self._shm_recv_loop,
+                             args=(channel, sock), daemon=True,
+                             name=f"mvtpu-shm-recv-dial-{self.rank}").start()
         return sock
 
     def _accept_loop(self) -> None:
@@ -626,63 +670,223 @@ class TcpNet:
                              daemon=True,
                              name=f"mvtpu-net-recv-{self.rank}").start()
 
+    def _read_frame(self, read, srcs_seen: set) -> Optional[Message]:
+        """Read ONE v3 frame off a byte stream (``read(n) -> bytes``) —
+        the parse shared by the TCP recv loop and the shm ring reader, so
+        both transports carry bit-identical framing. Returns None on a
+        CRC reject (the length header keeps the stream in sync; the frame
+        is discarded and retransmit recovers it); raises
+        :class:`_WireDesync` on an unparsable header."""
+        head = read(_HEADER.size)
+        (magic, version, channel, src, dst, mtype, table_id, msg_id,
+         req_id, nblobs, payload_len, crc) = _HEADER.unpack(head)
+        if magic != _MAGIC:
+            log.error("net: bad frame magic %x", magic)
+            raise _WireDesync("bad frame magic")
+        if version != _VERSION:
+            log.error("net: wire version %d from peer (want %d)",
+                      version, _VERSION)
+            raise _WireDesync("wire version mismatch")
+        srcs_seen.add(src)
+        # the header's payload_len keeps the stream in sync even when the
+        # payload is garbage: read it all, checksum, and only then parse
+        # blob structure out of it
+        payload = read(payload_len) if payload_len else b""
+        if zlib.crc32(payload) != crc:
+            count("FRAME_CRC_REJECTS")
+            log.error("net: CRC mismatch on %s frame from %d — "
+                      "frame discarded (retransmit recovers it)",
+                      MsgType(mtype), src)
+            hop(req_id, "net_crc_reject")
+            flight_dump("frame_crc_reject", src=src,
+                        msg_type=int(mtype), req_id=req_id)
+            return None
+        t0 = time.perf_counter()
+        off = 0
+        blobs = []
+        for _ in range(nblobs):
+            ndim, dt, nbytes = _BLOB.unpack_from(payload, off)
+            off += _BLOB.size
+            shape = struct.unpack_from(f"<{ndim}q", payload, off)
+            off += 8 * ndim
+            dtype = np.dtype(dt.decode().strip())
+            blobs.append(np.frombuffer(
+                payload, dtype=dtype, count=nbytes // dtype.itemsize,
+                offset=off).reshape(shape).copy())
+            off += nbytes
+        observe("FRAME_DECODE_SECONDS", time.perf_counter() - t0)
+        hop(req_id, "net_recv")
+        msg = Message(src=src, dst=dst, type=MsgType(mtype),
+                      table_id=table_id, msg_id=msg_id,
+                      req_id=req_id, data=blobs)
+        msg._wire_channel = channel
+        return msg
+
+    def _route(self, msg: Message) -> None:
+        """Deliver a received frame to its queue (mailbox / per-peer raw)."""
+        if getattr(msg, "_wire_channel", 0) == 1:
+            self._raw.setdefault(msg.src, MtQueue()).push(msg)
+        else:
+            self._mailbox.push(msg)
+
     def _recv_loop(self, conn: socket.socket) -> None:
         srcs_seen: set = set()
         try:
             while self._active:
-                head = _read_exact(conn, _HEADER.size)
-                (magic, version, channel, src, dst, mtype, table_id, msg_id,
-                 req_id, nblobs, payload_len, crc) = _HEADER.unpack(head)
-                if magic != _MAGIC:
-                    log.error("net: bad frame magic %x", magic)
+                try:
+                    msg = self._read_frame(
+                        lambda n: _read_exact(conn, n), srcs_seen)
+                except _WireDesync:
                     self._drop_conn(conn, srcs_seen)
                     return
-                if version != _VERSION:
-                    log.error("net: wire version %d from peer (want %d)",
-                              version, _VERSION)
-                    self._drop_conn(conn, srcs_seen)
-                    return
-                srcs_seen.add(src)
-                # the header's payload_len keeps the stream in sync even
-                # when the payload is garbage: read it all, checksum, and
-                # only then parse blob structure out of it
-                payload = _read_exact(conn, payload_len) if payload_len \
-                    else b""
-                if zlib.crc32(payload) != crc:
-                    count("FRAME_CRC_REJECTS")
-                    log.error("net: CRC mismatch on %s frame from %d — "
-                              "frame discarded (retransmit recovers it)",
-                              MsgType(mtype), src)
-                    hop(req_id, "net_crc_reject")
-                    flight_dump("frame_crc_reject", src=src,
-                                msg_type=int(mtype), req_id=req_id)
+                if msg is None:
+                    continue  # CRC reject; stream stays in sync
+                if msg.type == MsgType.Control_Shm:
+                    # transport-internal negotiation: never surfaces to
+                    # the mailbox/dispatcher
+                    self._shm_serve_accept(conn, msg)
                     continue
-                t0 = time.perf_counter()
-                off = 0
-                blobs = []
-                for _ in range(nblobs):
-                    ndim, dt, nbytes = _BLOB.unpack_from(payload, off)
-                    off += _BLOB.size
-                    shape = struct.unpack_from(f"<{ndim}q", payload, off)
-                    off += 8 * ndim
-                    dtype = np.dtype(dt.decode().strip())
-                    blobs.append(np.frombuffer(
-                        payload, dtype=dtype, count=nbytes // dtype.itemsize,
-                        offset=off).reshape(shape).copy())
-                    off += nbytes
-                observe("FRAME_DECODE_SECONDS", time.perf_counter() - t0)
-                hop(req_id, "net_recv")
-                msg = Message(src=src, dst=dst, type=MsgType(mtype),
-                              table_id=table_id, msg_id=msg_id,
-                              req_id=req_id, data=blobs)
+                if msg.type == MsgType.Control_Reply_Shm:
+                    continue  # stale duplicate; handshake reads inline
                 msg._conn = conn  # reply path for listener-less peers
-                if channel == 1:
-                    self._raw.setdefault(src, MtQueue()).push(msg)
-                else:
-                    self._mailbox.push(msg)
+                self._route(msg)
         except (ConnectionError, OSError):
             self._drop_conn(conn, srcs_seen)
             return
+
+    # -- shared-memory transport (runtime/shm.py) ---------------------------
+    def _shm_offer(self, sock: socket.socket) -> Optional[ShmChannel]:
+        """Inline shm handshake on a fresh dialed connection (nothing else
+        is on this wire yet, so a blocking read of the reply is safe).
+        Returns the live channel, or None — the caller keeps TCP. The
+        segment files are unlinked as soon as the handshake settles: both
+        sides hold mappings, so even a kill -9 cannot leak them.
+        Negotiation frames bypass the ChaosNet seams deliberately — chaos
+        targets data-plane frames; a dropped offer would silently change
+        which transport a chaos run exercises."""
+        from multiverso_tpu.runtime import shm as shm_mod
+        try:
+            paths, channel = shm_mod.create_pair(self._shm_bytes)
+        except OSError as exc:
+            log.error("shm: segment creation failed (%r); staying on TCP",
+                      exc)
+            return None
+        ok = False
+        try:
+            payload = json.dumps({"c2s": paths[0], "s2c": paths[1]}).encode()
+            msg = Message(src=self.rank, dst=-1, type=MsgType.Control_Shm,
+                          data=[np.frombuffer(payload, dtype=np.uint8)])
+            segments, _ = self._frame_segments(msg, 0)
+            sock.settimeout(10.0)
+            sock.sendall(b"".join(segments))
+            reply = self._read_frame(lambda n: _read_exact(sock, n), set())
+            if reply is None or reply.type != MsgType.Control_Reply_Shm:
+                log.error("shm: unexpected negotiation reply %s; staying "
+                          "on TCP", None if reply is None else reply.type)
+                return None
+            ans = json.loads(bytes(np.asarray(
+                reply.data[0], dtype=np.uint8)).decode()) if reply.data \
+                else {}
+            if not ans.get("ok"):
+                log.info("shm: peer declined (%s); staying on TCP",
+                         ans.get("error", "wire_shm off"))
+                return None
+            ok = True
+            return channel
+        except (ConnectionError, OSError, ValueError) as exc:
+            log.error("shm: negotiation failed (%r); staying on TCP", exc)
+            return None
+        finally:
+            try:
+                sock.settimeout(None)
+            except OSError:
+                pass
+            shm_mod.unlink_quiet(*paths)
+            if not ok:
+                channel.dispose()
+
+    def _shm_serve_accept(self, conn: socket.socket, msg: Message) -> None:
+        """Handle a Control_Shm offer: map the pair, start the ring
+        reader, accept — or refuse (flag off / unmappable, i.e. a
+        non-colocated peer) and the client transparently keeps TCP."""
+        from multiverso_tpu.runtime import shm as shm_mod
+        channel: Optional[ShmChannel] = None
+        error: Optional[str] = None
+        if not self._shm_enabled:
+            error = "wire_shm is off on this server"
+        else:
+            try:
+                spec = json.loads(bytes(np.asarray(
+                    msg.data[0], dtype=np.uint8)).decode())
+                channel = shm_mod.open_pair(str(spec["c2s"]),
+                                            str(spec["s2c"]))
+            except (OSError, ValueError, KeyError, IndexError) as exc:
+                error = f"cannot map offered segments: {exc!r}"
+        payload: Dict[str, Any] = {"ok": error is None}
+        if error is not None:
+            payload["error"] = error
+            log.info("shm: offer declined: %s", error)
+        reply = Message(src=self.rank, dst=msg.src,
+                        type=MsgType.Control_Reply_Shm, msg_id=msg.msg_id,
+                        data=[np.frombuffer(json.dumps(payload).encode(),
+                                            dtype=np.uint8)])
+        segments, nbytes = self._frame_segments(reply, 0)
+        try:
+            # the reply MUST ride TCP — the channel is registered only
+            # after the send, or the divert in _enqueue would put the
+            # accept on a ring the client is not reading yet. Plain
+            # _enqueue: negotiation bypasses the chaos seams like the
+            # offer does (they intercept _send/send_via only).
+            self._enqueue(conn, segments, nbytes)
+        except OSError as exc:
+            log.error("shm: accept reply failed: %r", exc)
+            if channel is not None:
+                channel.dispose()
+            return
+        if channel is not None:
+            with self._conn_lock:
+                self._shm_channels[conn] = channel
+            threading.Thread(target=self._shm_recv_loop,
+                             args=(channel, conn), daemon=True,
+                             name=f"mvtpu-shm-recv-{self.rank}").start()
+            log.info("shm: transport negotiated (ring %d bytes/dir)",
+                     channel.rx.capacity)
+
+    def _shm_recv_loop(self, channel: ShmChannel,
+                       conn: socket.socket) -> None:
+        """Ring-side twin of ``_recv_loop``: same framing, same routing;
+        replies to ring-arrived frames address the CHANNEL (``msg._conn``),
+        so they ride the ring back. The reader owns the mappings' final
+        release — it is the last thread touching them."""
+        from multiverso_tpu.runtime.shm import _shm_metrics
+        rx_frames = _shm_metrics()[2]
+        srcs_seen: set = set()
+        try:
+            while self._active:
+                try:
+                    msg = self._read_frame(channel.read_exact, srcs_seen)
+                except _WireDesync:
+                    # garbage on the ring: kill the whole connection (TCP
+                    # included) — the reconnect path renegotiates
+                    self._drop_conn(conn, srcs_seen)
+                    break
+                if msg is None:
+                    continue  # CRC reject; stream stays in sync
+                rx_frames.add(1)
+                msg._conn = channel
+                self._route(msg)
+        except (ConnectionError, OSError):
+            if self._active and not channel.closed:
+                # the PEER killed the ring (its finalize flipped the
+                # shared flags) while our TCP side may sit in a blocked
+                # recv that a dead socket cannot always interrupt: run
+                # the same conn-drop path a TCP EOF would — pops the
+                # socket AND the channel, pushes the peer-lost sentinels
+                # that wake blocked waiters into recovery
+                self._drop_conn(conn, srcs_seen)
+        finally:
+            channel.close()
+            channel.dispose()
 
     def _drop_conn(self, conn: socket.socket, srcs_seen: set) -> None:
         """A connection died: prune its bookkeeping and — if the transport
@@ -691,12 +895,17 @@ class TcpNet:
         until finalize(). Only the dead peer's raw queues are poisoned."""
         with self._conn_lock:
             state = self._send_states.pop(conn, None)
+            channel = self._shm_channels.pop(conn, None)
             if conn in self._accepted:
                 self._accepted.remove(conn)
             for rank, sock in list(self._conns.items()):
                 if sock is conn:
                     del self._conns[rank]
                     srcs_seen = srcs_seen | {rank}
+        if channel is not None:
+            # the TCP liveness channel died: fail ring waiters fast (its
+            # reader thread disposes the mappings on exit)
+            channel.close()
         if state is not None:
             # fail queued frames + wake flush/backpressure waiters; the
             # drain thread exits on the error mark

@@ -30,6 +30,28 @@ from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
+_apply_metrics_cache = None
+
+
+def _apply_metrics():
+    """Apply-path metric objects resolved once — the registry lock must
+    not sit inside the dispatcher drain loop (Dashboard.reset zeroes
+    objects in place, so cached references stay live). APPLY_BATCH_ROWS
+    is count-valued: unit-based geometric bounds (1..2^27 rows), not the
+    1µs latency default whose top edge it would overflow."""
+    global _apply_metrics_cache
+    if _apply_metrics_cache is None:
+        from multiverso_tpu.dashboard import Dashboard
+        from multiverso_tpu.obs.metrics import log_bounds
+        _apply_metrics_cache = (
+            Dashboard.counter("APPLY_FUSED_CALLS"),
+            Dashboard.counter("APPLY_BATCHED_MSGS"),
+            Dashboard.histogram("APPLY_BATCH_ROWS",
+                                bounds=log_bounds(lowest=1.0)),
+            Dashboard.gauge("SERVER_QUEUE_DEPTH"),
+        )
+    return _apply_metrics_cache
+
 
 class _NullCompletion:
     """Fire-and-forget completion for internally-generated dispatcher work
@@ -90,6 +112,12 @@ class Server:
     # (deterministic ordering): fused add+get replies are None — clients
     # should send reply-free pushes and pull separately.
     defers_adds = False
+    # True on servers whose dispatcher may micro-batch queued Adds into
+    # one fused table apply (the Downpour-tolerated reordering). The
+    # round-gated and deterministic servers keep it False: their
+    # (round, worker) ordering admits no compatible multi-message group,
+    # so they apply per message exactly as before.
+    fuses_adds = True
 
     @property
     def plain_async(self) -> bool:
@@ -127,6 +155,11 @@ class Server:
         # (stalls, lease evictions) carry which shard spoke; -1 = not a
         # shard-group member.
         self.shard_id = -1
+        # micro-batch cap: how many queued Adds one drain may fuse into a
+        # single table apply (0 = legacy per-message dispatch); read once
+        # at construction like the wire coalescing caps
+        self._apply_batch_cap = max(0, int(
+            config.get_flag("apply_batch_msgs")))
 
     def _ident(self) -> str:
         """Log prefix naming this dispatcher when it is one of many."""
@@ -201,19 +234,146 @@ class Server:
     # -- dispatcher --------------------------------------------------------
     def _main(self) -> None:
         self._started.set()
+        fuse = self.fuses_adds and self._apply_batch_cap > 0
+        queue_gauge = _apply_metrics()[3]
         while True:
-            msg = self._queue.pop()
-            if msg is None:
+            msgs = self._queue.pop_all()
+            if msgs is None:
                 return
-            # depth AFTER the pop = requests still waiting behind this one
-            gauge_set("SERVER_QUEUE_DEPTH", self._queue.size())
+            # depth AFTER the drain = requests that arrived behind this
+            # wakeup's batch; sampled once per drain, not once per message
+            # (per-message sampling was pure hot-loop overhead)
+            queue_gauge.set(self._queue.size())
+            if fuse and len(msgs) > 1:
+                self._dispatch_batch(msgs)
+            else:
+                for msg in msgs:
+                    self._dispatch_guarded(msg)
+
+    def _dispatch_guarded(self, msg: Message) -> None:
+        try:
+            with monitor("SERVER_DISPATCH_MSG"):
+                self._dispatch(msg)
+        except Exception as exc:  # keep the dispatcher alive; fail the waiter
+            log.error("server dispatcher error on %s: %r", msg.type, exc)
+            if msg.data and hasattr(msg.data[-1], "fail"):
+                msg.data[-1].fail(exc)
+
+    @staticmethod
+    def _fusable_add(msg: Message) -> bool:
+        """Adds the drain loop may hold back and group: plain table Adds.
+        Device transactions (request[0] is a tag string) read/write
+        MULTIPLE tables — they are full barriers, like any non-Add."""
+        if msg.type != MsgType.Request_Add or not msg.data:
+            return False
+        request = msg.data[0]
+        return not (isinstance(request, tuple) and request
+                    and isinstance(request[0], str))
+
+    def _dispatch_batch(self, msgs: List[Message]) -> None:
+        """Micro-batched drain (the receive-side mirror of the PR-5 send
+        coalescing): walk the drained backlog in arrival order, holding
+        plain Adds back in per-table groups; a Get flushes ITS table's
+        group first (per-worker FIFO — a worker's own earlier Adds are
+        always visible to its Get), any other message is a full barrier.
+        Within one flushed group, Adds from different workers reorder
+        into a single fused apply — the commutative-Add reordering
+        Downpour SGD (Dean et al., NIPS 2012) explicitly tolerates."""
+        pending: Dict[int, List[Message]] = {}
+
+        def flush(table_id: Optional[int] = None) -> None:
+            if table_id is None:
+                for tid in list(pending):
+                    flush(tid)
+                return
+            batch = pending.pop(table_id, None)
+            if batch:
+                self._apply_add_batch(table_id, batch)
+
+        for msg in msgs:
+            if self._fusable_add(msg):
+                pending.setdefault(msg.table_id, []).append(msg)
+                continue
+            if msg.type == MsgType.Request_Get:
+                flush(msg.table_id)
+            else:
+                flush()
+            self._dispatch_guarded(msg)
+        flush()
+
+    def _apply_add_batch(self, table_id: int, msgs: List[Message]) -> None:
+        cap = self._apply_batch_cap
+        while msgs:
+            consumed = self._apply_add_chunk(table_id, msgs[:cap])
+            msgs = msgs[consumed:]
+
+    def _apply_add_chunk(self, table_id: int, msgs: List[Message]) -> int:
+        """Fuse-and-apply a prefix of ``msgs``; returns how many messages
+        were handled (the table's merge may consume fewer than offered to
+        bound the fused-apply size)."""
+        if len(msgs) == 1:
+            self._dispatch_guarded(msgs[0])
+            return 1
+        table = self._tables.get(table_id)
+        merged = None
+        if table is not None:
             try:
-                with monitor("SERVER_DISPATCH_MSG"):
-                    self._dispatch(msg)
-            except Exception as exc:  # keep the dispatcher alive; fail the waiter
-                log.error("server dispatcher error on %s: %r", msg.type, exc)
-                if msg.data and hasattr(msg.data[-1], "fail"):
-                    msg.data[-1].fail(exc)
+                merged = table.merge_add_requests(
+                    [m.data[0] for m in msgs])
+            except Exception as exc:  # merge must never sink the batch
+                log.error("server: merge_add_requests failed on table %d "
+                          "(%r); applying per message", table_id, exc)
+                merged = None
+        if merged is None:
+            # the FIRST request cannot merge: dispatch it alone and offer
+            # the rest again — a lone incompatible request must not
+            # degrade its whole group to per-message dispatch. (Tables
+            # that never merge return None without scanning, so the extra
+            # calls cost an attribute lookup each.)
+            self._dispatch_guarded(msgs[0])
+            return 1
+        request, rows, consumed = merged
+        consumed = max(1, min(int(consumed), len(msgs)))
+        if consumed == 1:
+            self._dispatch_guarded(msgs[0])
+            return 1
+        msgs = msgs[:consumed]
+        # WAL entries per Add, in arrival order, BEFORE the fused apply
+        # (the PR-2 invariant: an ACKed Add is always recoverable);
+        # recovery replays the records individually, which sums to the
+        # same state for the commutative Adds that merged at all
+        for msg in msgs:
+            self._wal_append(msg)
+            hop(msg.req_id, "apply_add")
+        fused_c, batched_c, rows_h, _g = _apply_metrics()
+        try:
+            with monitor("SERVER_PROCESS_ADD_MSG"):
+                self._apply_fused(table, request)
+        except Exception as exc:
+            # merge validated shapes, so this is rare; the contract that
+            # makes the retry safe: process_add validates before it
+            # mutates, so a raised error means nothing applied
+            log.error("server: fused apply of %d adds on table %d failed "
+                      "(%r); retrying per message", len(msgs), table_id,
+                      exc)
+            for msg in msgs:
+                try:
+                    with monitor("SERVER_PROCESS_ADD_MSG"):
+                        msg.data[-1].done(table.process_add(msg.data[0]))
+                except Exception as per_exc:
+                    msg.data[-1].fail(per_exc)
+            return consumed
+        fused_c.add(1)
+        batched_c.add(len(msgs))
+        rows_h.observe(rows)
+        for msg in msgs:
+            msg.data[-1].done(None)
+        return consumed
+
+    def _apply_fused(self, table, request) -> None:
+        """The fused apply — a named seam so crash-point tests can kill
+        the process between a batch's WAL appends and its apply."""
+        table.process_add(request)
 
     def _dispatch(self, msg: Message) -> None:
         if msg.type == MsgType.Request_Add:
@@ -272,6 +432,9 @@ class DeterministicServer(Server):
     """
 
     defers_adds = True
+    # (round, worker) apply order admits no multi-message fused group:
+    # the drain loop dispatches per message, exactly as before
+    fuses_adds = False
 
     def __init__(self, num_workers: int) -> None:
         super().__init__(num_workers)
@@ -324,6 +487,10 @@ class SyncServer(Server):
     contract with per-worker vector clocks and deferred request caches."""
 
     gates_gets = True
+    # the two-sided clock defers/releases every Add itself — per-message
+    # dispatch is the gate (SSPServer inherits: its Adds bump per-worker
+    # clocks that a fused apply could not account)
+    fuses_adds = False
 
     def __init__(self, num_workers: int) -> None:
         super().__init__(num_workers)

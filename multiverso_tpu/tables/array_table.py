@@ -26,7 +26,8 @@ from multiverso_tpu.parallel import mesh as mesh_lib
 from multiverso_tpu.runtime.zoo import Zoo
 from multiverso_tpu.tables.base import ServerTable, WorkerTable
 from multiverso_tpu.utils import async_upload
-from multiverso_tpu.updaters import AddOption, GetOption, Updater, get_updater
+from multiverso_tpu.updaters import (AddOption, GetOption, SGDUpdater,
+                                     Updater, get_updater)
 
 
 def _make_whole_update(updater: Updater, jit: bool = True):
@@ -83,6 +84,32 @@ class ArrayServer(ServerTable):
         self._codecs: Dict = {}  # leaf-signature -> (to_flat, from_flat)
 
     # -- server ops --------------------------------------------------------
+    def merge_add_requests(self, requests):
+        """Whole-array host deltas sum into ONE update — linear updaters
+        only (a stateful updater applied once to a summed delta is a
+        different operator than N sequential applies). The fused
+        add+get form (3-tuple), leaf-tagged forms, and device-resident
+        deltas all refuse: their replies/payloads are per-request."""
+        if type(self.updater) not in (Updater, SGDUpdater):
+            return None
+        total = None
+        consumed = 0
+        for request in requests:
+            if not (isinstance(request, tuple) and len(request) == 2):
+                break
+            delta, _option = request
+            if delta is None or isinstance(delta, jax.Array):
+                break
+            arr = np.asarray(delta, dtype=self.dtype).reshape(-1)
+            if arr.size != self.size:
+                break  # per-message path reports the real error
+            total = arr.astype(self.dtype, copy=True) if total is None \
+                else total + arr
+            consumed += 1
+        if total is None:
+            return None
+        return (total, requests[0][1]), int(total.size), consumed
+
     def _leaf_codec(self, leaves):
         """jitted (to_flat, from_flat) for a list-of-arrays signature.
         from_flat's outputs are committed to ONE device (out_shardings):

@@ -235,6 +235,25 @@ class ServerTable:
             arr = self._replicate(arr)
         return np.asarray(jax.device_get(arr))
 
+    def merge_add_requests(self, requests):
+        """Fuse a PREFIX of a drained group of Add requests into ONE
+        request (the dispatcher's micro-batch path, runtime/server.py):
+        return ``(merged_request, rows, consumed)`` — where
+        ``process_add(merged)`` is equivalent to applying the first
+        ``consumed`` requests in turn (up to the commutative-Add
+        reordering Downpour tolerates) and ``rows`` feeds the
+        APPLY_BATCH_ROWS histogram — or None when even the first request
+        cannot merge (the dispatcher then applies per message, exactly as
+        before). Consuming a prefix lets a table bound the fused-apply
+        size (e.g. the matrix row cap) without giving up batching for
+        the remainder.
+
+        Contract: MUST NOT mutate table state; the eventual
+        ``process_add(merged)`` must validate before it mutates, so a
+        raised error means nothing applied (the dispatcher retries the
+        group per message). Default: no batching."""
+        return None
+
     def process_add(self, request: Any) -> None:
         raise NotImplementedError
 

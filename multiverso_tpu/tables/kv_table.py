@@ -36,6 +36,27 @@ class KVServer(ServerTable):
         self.value_dtype = np.dtype(value_dtype)
         self._store: Dict[int, Any] = {}
 
+    def merge_add_requests(self, requests):
+        """Key/value add streams concatenate: ``process_add`` folds the
+        merged pair list in exactly the original arrival order, so one
+        fused apply is bit-identical to per-message applies — the only
+        saving is the per-message dispatch/WAL-bracket overhead."""
+        keys: list = []
+        values: list = []
+        consumed = 0
+        for request in requests:
+            if not (isinstance(request, tuple) and len(request) == 3):
+                break
+            k, v, _option = request
+            if k is None or v is None or len(k) != len(v):
+                break  # per-message path reports the real error
+            keys.extend(list(k))
+            values.extend(list(v))
+            consumed += 1
+        if not consumed:
+            return None
+        return (keys, values, requests[0][2]), len(keys), consumed
+
     def process_add(self, request) -> None:
         keys, values, _option = request
         for k, v in zip(keys, values):

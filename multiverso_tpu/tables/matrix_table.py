@@ -127,6 +127,17 @@ class MatrixServer(ServerTable):
         self._linear = type(self.updater) in (Updater, SGDUpdater)
         self._sign = -1.0 if isinstance(self.updater, SGDUpdater) else 1.0
         self._gather = jax.jit(lambda data, ids: data[ids])
+        # device-out gets feed WORKER-thread jits (the word2vec fast
+        # path's compact training space): committed to ONE device so
+        # those jits are single-device programs — concurrent sharded
+        # executions from worker threads deadlock the CPU backend's
+        # collective rendezvous while the dispatcher runs its own sharded
+        # gather (the same decision, for the same reason, as
+        # ArrayServer._leaf_codec; scatters re-shard on the way back in)
+        from jax.sharding import SingleDeviceSharding
+        _out_dev = SingleDeviceSharding(jax.devices()[0])
+        self._gather_out = lambda data, ids: jax.device_put(
+            self._gather(data, ids), _out_dev)
         self._pallas_scatter = _use_pallas_scatter(
             jax.default_backend(), num_shards)
         if self._pallas_scatter:
@@ -198,6 +209,52 @@ class MatrixServer(ServerTable):
         return async_upload(ids_p), vals_p, n
 
     # -- server ops --------------------------------------------------------
+    def merge_add_requests(self, requests):
+        """Fuse queued host row-Adds into ONE scatter: concatenate
+        (ids, values) across the group and hand back one request whose
+        apply is a single jitted/pallas scatter_add. Duplicate rows are
+        pre-aggregated client-style INSIDE ``process_add`` (the shared
+        ``remote.merge_duplicate_rows``) exactly when the apply path
+        requires unique ids — the pallas in-place row-DMA kernel and
+        stateful updaters; XLA's scatter-add handles duplicates natively,
+        so the linear non-pallas path skips the host-side aggregation
+        entirely. Linear updaters only — a stateful updater
+        (momentum/adagrad) applied once to a summed delta is a different
+        operator than N sequential applies. Whole-table, device-resident,
+        and transact forms stop the scan (None when FIRST — per-message
+        dispatch; otherwise the compatible prefix fuses and the rest
+        waits for the next call). The ``apply_batch_rows`` flag bounds
+        the fused row count so the power-of-two id bucket (and its
+        zero-padded upload) cannot blow up under backlog."""
+        if not self._linear:
+            return None
+        from multiverso_tpu import config as config_mod
+        rows_cap = int(config_mod.get_flag("apply_batch_rows"))
+        ids_list, vals_list = [], []
+        total = 0
+        for request in requests:
+            if not (isinstance(request, tuple) and len(request) == 3):
+                break
+            row_ids, values, _option = request
+            if row_ids is None or isinstance(values, jax.Array):
+                break
+            row_ids = np.asarray(row_ids, dtype=np.int32).reshape(-1)
+            values = np.asarray(values, dtype=self.dtype).reshape(
+                -1, self.num_col)
+            if len(row_ids) != len(values):
+                break  # per-message path reports the real error
+            if ids_list and rows_cap > 0 \
+                    and total + len(row_ids) > rows_cap:
+                break
+            ids_list.append(row_ids)
+            vals_list.append(values)
+            total += len(row_ids)
+        if not ids_list:
+            return None
+        ids = np.concatenate(ids_list)
+        return ((ids, np.concatenate(vals_list), requests[0][2]),
+                int(len(ids)), len(ids_list))
+
     def process_add(self, request):
         if isinstance(request[0], str) and request[0] == "transact":
             return self._process_transact(request)
@@ -232,12 +289,14 @@ class MatrixServer(ServerTable):
             # unique ids: required by stateful updaters (one apply per row)
             # and by the pallas scatter kernel's in-place row DMA contract;
             # XLA's scatter-add handles duplicates natively, so the linear
-            # non-pallas path skips the host-side aggregation
+            # non-pallas path skips the host-side aggregation (fused
+            # micro-batches from the dispatcher concatenate without
+            # dedup for exactly this reason)
             if not (self._linear and not self._pallas_scatter):
-                row_ids, inv = np.unique(row_ids, return_inverse=True)
-                agg = np.zeros((len(row_ids), self.num_col), dtype=values.dtype)
-                np.add.at(agg, inv, values)
-                values = agg
+                # lazy import: remote imports this module (worker proxies)
+                from multiverso_tpu.runtime.remote import \
+                    merge_duplicate_rows
+                row_ids, values = merge_duplicate_rows(row_ids, values)
             ids_p, vals_p, _ = self._bucket_ids(row_ids, values)
             if self._linear:
                 self.data = self._scatter_add(self.data, ids_p, self._sign * vals_p)
@@ -265,6 +324,12 @@ class MatrixServer(ServerTable):
             [row_ids, np.full(bucket - n, self.sentinel_row, np.int32)]))
         vals_p = _device_pad(values.astype(self.dtype), bucket,
                              self.padded_cols)
+        # worker-thread kernels hand deltas back committed to ONE device
+        # (the gather_out contract); re-shard here — on the dispatcher
+        # thread, where cross-shard collectives are legal — or the
+        # scatter jit would reject the mixed device sets
+        vals_p = jax.device_put(
+            vals_p, mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0))
         if self._linear:
             self.data = self._scatter_add(self.data, ids_p,
                                           self._sign * vals_p)
@@ -372,7 +437,8 @@ class MatrixServer(ServerTable):
             # training space contract); host/wire gets may not
             self._check_row_range(row_ids, "get")
         ids_p, _, n = self._bucket_ids(row_ids, None, ensure_pad=device_out)
-        gathered = self._gather(self.data, ids_p)
+        gathered = (self._gather_out if device_out
+                    else self._gather)(self.data, ids_p)
         if self.is_sparse and self._is_worker(option):
             with self._std_lock:
                 self._up_to_date[option.worker_id, row_ids] = True

@@ -50,6 +50,22 @@ class MtQueue(Generic[T]):
                 return self._items.popleft()
             return None
 
+    def pop_all(self) -> Optional[list]:
+        """Blocking drain: wait like :meth:`pop`, then return EVERY queued
+        item at once (arrival order). None once Exit() is called and the
+        queue is empty — same shutdown contract as ``pop``. This is the
+        dispatcher's micro-batching primitive: one wakeup hands the server
+        the whole backlog so compatible Adds can fuse into a single device
+        apply instead of paying per-message dispatch."""
+        with self._nonempty:
+            while not self._items and self._alive:
+                self._nonempty.wait()
+            if not self._items:
+                return None
+            items = list(self._items)
+            self._items.clear()
+            return items
+
     def try_pop(self) -> Optional[T]:
         with self._mutex:
             if self._items:

@@ -33,11 +33,14 @@ from multiverso_tpu.runtime.zoo import Zoo  # noqa: E402
 
 def _apply_env_flag_overrides():
     """CI chaos-matrix hook: MV_WIRE_COALESCE_FRAMES/_BYTES force the
-    vectored-send caps for a whole suite run, so fault injection
-    exercises the coalescing wire path at a chosen aggressiveness (one
-    ci.yml matrix entry sets them; see .github/workflows/ci.yml)."""
+    vectored-send caps, MV_WIRE_SHM=1 forces the shared-memory ring
+    transport, and MV_APPLY_BATCH_MSGS overrides the dispatcher's fused-
+    apply cap — so fault injection exercises a chosen wire/apply posture
+    for a whole suite run (ci.yml matrix entries set them)."""
     for env, flag in (("MV_WIRE_COALESCE_FRAMES", "wire_coalesce_frames"),
-                      ("MV_WIRE_COALESCE_BYTES", "wire_coalesce_bytes")):
+                      ("MV_WIRE_COALESCE_BYTES", "wire_coalesce_bytes"),
+                      ("MV_WIRE_SHM", "wire_shm"),
+                      ("MV_APPLY_BATCH_MSGS", "apply_batch_msgs")):
         raw = os.environ.get(env)
         if raw:
             mv.set_flag(flag, raw)

@@ -10,7 +10,13 @@ Usage: python durable_primary_child.py <port> <wal_dir> [options]
                                 os._exit(9) on the N-th wire Add at point
                                 P: before_append (nothing logged),
                                 after_append (logged, apply/ACK never
-                                happen), after_ack (logged+applied+ACKed)
+                                happen), after_ack (logged+applied+ACKed),
+                                mid_batch (the N-th FUSED apply: the whole
+                                micro-batch is WAL-logged, the fused
+                                scatter and every ACK never happen)
+    --batch-hold=N              dispatcher drains only once N messages are
+                                queued — forces a deterministic N-message
+                                fused batch for the mid_batch point
 
 Prints ``serving <endpoint> <table_id>`` once ready, then sleeps until
 killed (or until the armed crash fires)."""
@@ -47,6 +53,21 @@ def _arm_crash(point: str, at: int) -> None:
                 os._exit(9)
 
         Server._wal_append = hooked
+    elif point == "mid_batch":
+        # kill between a micro-batch's WAL appends and its fused apply:
+        # every Add in the batch is logged but neither applied nor ACKed —
+        # recovery must replay all of them and the dedup seeds must
+        # swallow the client's retransmits (zero lost, zero doubled)
+        from multiverso_tpu.runtime.server import Server
+        orig_fused = Server._apply_fused
+
+        def hooked_fused(self, table, request):
+            state["appends"] += 1
+            if state["appends"] == at:
+                os._exit(9)
+            orig_fused(self, table, request)
+
+        Server._apply_fused = hooked_fused
     elif point == "after_ack":
         from multiverso_tpu.runtime import remote
         from multiverso_tpu.runtime.message import MsgType
@@ -64,15 +85,37 @@ def _arm_crash(point: str, at: int) -> None:
         raise SystemExit(f"unknown crash point {point!r}")
 
 
+def _arm_batch_hold(n: int) -> None:
+    """Make the dispatcher drain only once ``n`` messages are queued — a
+    deterministic fused batch (the dispatcher queue is the only pop_all
+    user in this process)."""
+    from multiverso_tpu.utils import MtQueue
+    orig = MtQueue.pop_all
+
+    def held(self):
+        while self.alive and self.size() < n:
+            time.sleep(0.005)
+        return orig(self)
+
+    MtQueue.pop_all = held
+
+
 def main() -> int:
     port, wal_dir = sys.argv[1], sys.argv[2]
     opts = sys.argv[3:]
     crash_point, crash_at = None, 0
+    batch_hold = 0
     for arg in opts:
         if arg.startswith("--crash-point="):
             crash_point = arg.split("=", 1)[1]
         elif arg.startswith("--crash-at="):
             crash_at = int(arg.split("=", 1)[1])
+        elif arg.startswith("--batch-hold="):
+            batch_hold = int(arg.split("=", 1)[1])
+    if batch_hold > 0:
+        # BEFORE mv.init: the dispatcher thread blocks inside pop_all from
+        # startup, so patching later would miss its first (held) drain
+        _arm_batch_hold(batch_hold)
     flags = dict(ps_role="server", remote_workers=2, wal_dir=wal_dir,
                  heartbeat_seconds=0.2, lease_seconds=30.0)
     if "--sync" in opts:

@@ -1,0 +1,329 @@
+"""Micro-batched fused apply (runtime/server.py drain batching).
+
+The apply-path contract under batching:
+* the async server's final state is bit-identical to unbatched dispatch
+  for commutative Adds (integer-valued float deltas make the sums exact,
+  so the Downpour-tolerated reordering cannot blur the comparison);
+* per-worker FIFO holds — a Get observes every Add the same worker queued
+  before it on that table;
+* non-Add messages (Server_Execute, transactions) are full barriers;
+* deterministic/BSP servers are unaffected (they never fuse);
+* the APPLY_* telemetry proves batching actually happened.
+
+``tests/test_durable.py::test_crash_point_mid_batch_recovery_exactly_once``
+covers the WAL half: a kill -9 between a batch's appends and its fused
+apply loses zero acknowledged Adds.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.runtime.message import Message, MsgType
+from multiverso_tpu.runtime.server import (DeterministicServer, Server,
+                                           SSPServer, SyncServer,
+                                           _ExecWaiter)
+from multiverso_tpu.runtime.zoo import Zoo
+from multiverso_tpu.utils import MtQueue
+
+
+# -- the drain primitive ------------------------------------------------------
+
+def test_pop_all_drains_in_arrival_order():
+    q = MtQueue()
+    for i in range(5):
+        q.push(i)
+    assert q.pop_all() == [0, 1, 2, 3, 4]
+    assert q.empty()
+
+
+def test_pop_all_blocks_until_item_and_exits_clean():
+    q = MtQueue()
+    got = []
+
+    def consumer():
+        while True:
+            items = q.pop_all()
+            if items is None:
+                return
+            got.extend(items)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.push("a")
+    q.push("b")
+    time.sleep(0.05)
+    q.exit()
+    t.join(timeout=5)
+    assert not t.is_alive() and got == ["a", "b"]
+
+
+def test_pop_all_returns_leftovers_then_none_after_exit():
+    q = MtQueue()
+    q.push(1)
+    q.push(2)
+    q.exit()
+    assert q.pop_all() == [1, 2]
+    assert q.pop_all() is None
+
+
+# -- forced-batch helpers -----------------------------------------------------
+
+def _hold_dispatcher(server):
+    """Block the dispatcher inside a Server_Execute until the returned
+    event is set — everything queued behind it lands in ONE drain."""
+    gate = threading.Event()
+    waiter = _ExecWaiter()
+    server.send(Message(src=-1, dst=-1, type=MsgType.Server_Execute,
+                        data=[lambda: gate.wait(30), waiter]))
+    time.sleep(0.05)  # let the dispatcher enter the gate
+    return gate, waiter
+
+
+# -- fused apply: telemetry + exactness ---------------------------------------
+
+def test_forced_batch_fuses_matrix_adds_and_counts():
+    mv.init()
+    table = mv.create_table("matrix", num_row=64, num_col=8)
+    server = Zoo.instance().server
+    assert type(server) is Server and server.fuses_adds
+    gate, _ = _hold_dispatcher(server)
+    ids = np.array([1, 2, 3, 5], np.int32)
+    vals = np.ones((4, 8), np.float32)
+    handles = [table.add_async(vals, row_ids=ids) for _ in range(8)]
+    gate.set()
+    for h in handles:
+        table.wait(h)
+    assert Dashboard.counter_value("APPLY_FUSED_CALLS") == 1
+    assert Dashboard.counter_value("APPLY_BATCHED_MSGS") == 8
+    hist = Dashboard.histogram("APPLY_BATCH_ROWS")
+    assert hist.count == 1 and hist.max == 32.0  # 8 msgs x 4 rows fused
+    out = table.get(ids)
+    np.testing.assert_array_equal(out, np.full((4, 8), 8.0, np.float32))
+    mv.shutdown()
+
+
+def _run_matrix_workload(batch: bool):
+    """The same 24-message integer-delta workload, forced through one
+    drain (batch=True) or dispatched per message (apply_batch_msgs=0)."""
+    Dashboard.reset()  # isolate each leg's APPLY_* counters
+    mv.set_flag("apply_batch_msgs", 64 if batch else 0)
+    mv.init()
+    table = mv.create_table("matrix", num_row=32, num_col=4)
+    rng = np.random.default_rng(11)
+    server = Zoo.instance().server
+    gate = None
+    if batch:
+        gate, _ = _hold_dispatcher(server)
+    handles = []
+    for _ in range(24):
+        ids = rng.choice(32, 6, replace=False).astype(np.int32)
+        vals = rng.integers(-4, 5, size=(6, 4)).astype(np.float32)
+        handles.append(table.add_async(vals, row_ids=ids))
+    if gate is not None:
+        gate.set()
+    for h in handles:
+        table.wait(h)
+    final = np.asarray(table.get(), np.float32)
+    fused = Dashboard.counter_value("APPLY_FUSED_CALLS")
+    mv.shutdown()
+    return final, fused
+
+
+def test_batched_final_state_bit_identical_to_unbatched():
+    batched, fused = _run_matrix_workload(batch=True)
+    unbatched, fused_legacy = _run_matrix_workload(batch=False)
+    assert fused >= 1, "the batched run never actually fused"
+    assert fused_legacy == 0, "apply_batch_msgs=0 must disable fusing"
+    np.testing.assert_array_equal(batched, unbatched)
+
+
+def test_get_flushes_own_table_first_per_worker_fifo():
+    mv.init()
+    table_a = mv.create_table("matrix", num_row=16, num_col=4)
+    table_b = mv.create_table("matrix", num_row=16, num_col=4)
+    server = Zoo.instance().server
+    gate, _ = _hold_dispatcher(server)
+    ids = np.array([3], np.int32)
+    add_a = table_a.add_async(np.full((1, 4), 7.0, np.float32), row_ids=ids)
+    add_b = table_b.add_async(np.full((1, 4), 9.0, np.float32), row_ids=ids)
+    get_a = table_a.get_async(ids)
+    gate.set()
+    # the Get drained behind the Adds must observe table A's add (its
+    # group flushed first); table B's pending add flushes at drain end
+    got = table_a.wait_get(get_a, ids)
+    np.testing.assert_array_equal(got, np.full((1, 4), 7.0, np.float32))
+    table_a.wait(add_a)
+    table_b.wait(add_b)
+    np.testing.assert_array_equal(table_b.get(ids),
+                                  np.full((1, 4), 9.0, np.float32))
+    mv.shutdown()
+
+
+def test_server_execute_is_full_barrier():
+    """A Server_Execute drained behind pending Adds must observe them all
+    applied (checkpoint/multihost quiesce rides this message type)."""
+    mv.init()
+    table = mv.create_table("matrix", num_row=16, num_col=4)
+    server = Zoo.instance().server
+    gate, _ = _hold_dispatcher(server)
+    ids = np.array([2, 4], np.int32)
+    handles = [table.add_async(np.ones((2, 4), np.float32), row_ids=ids)
+               for _ in range(5)]
+    snap_waiter = _ExecWaiter()
+    server_table = table._server_table
+
+    def snap():
+        return np.asarray(server_table.process_get((ids, None)), np.float32)
+
+    server.send(Message(src=-1, dst=-1, type=MsgType.Server_Execute,
+                        data=[snap, snap_waiter]))
+    gate.set()
+    observed = snap_waiter.wait(30)
+    np.testing.assert_array_equal(observed, np.full((2, 4), 5.0, np.float32))
+    for h in handles:
+        table.wait(h)
+    mv.shutdown()
+
+
+# -- merge units --------------------------------------------------------------
+
+def test_matrix_merge_refuses_incompatible_forms():
+    mv.init()
+    table = mv.create_table("matrix", num_row=16, num_col=4)
+    st = table._server_table
+    ids = np.array([1, 2], np.int32)
+    vals = np.ones((2, 4), np.float32)
+    ok = st.merge_add_requests([(ids, vals, None), (ids, vals, None)])
+    assert ok is not None
+    merged, rows, consumed = ok
+    # concatenation, not dedup: XLA's scatter handles duplicates natively
+    # and the pallas path dedups inside process_add (shared
+    # merge_duplicate_rows) — the merge itself must stay cheap
+    assert rows == 4 and consumed == 2
+    np.testing.assert_array_equal(merged[0], np.array([1, 2, 1, 2],
+                                                      np.int32))
+    # a whole-table add FIRST refuses outright; an incompatible request
+    # mid-group stops the scan — only the compatible prefix fuses
+    assert st.merge_add_requests([(None, vals, None),
+                                  (ids, vals, None)]) is None
+    prefix = st.merge_add_requests([(ids, vals, None),
+                                    (None, vals, None),
+                                    (ids, vals, None)])
+    assert prefix is not None and prefix[2] == 1
+    # the apply_batch_rows cap bounds the fused prefix
+    mv.set_flag("apply_batch_rows", 3)
+    capped = st.merge_add_requests([(ids, vals, None), (ids, vals, None),
+                                    (ids, vals, None)])
+    assert capped is not None and capped[1] == 2 and capped[2] == 1
+    mv.shutdown()
+
+
+def test_matrix_merge_refuses_stateful_updaters():
+    mv.init()
+    table = mv.create_table("matrix", num_row=16, num_col=4,
+                            updater_type="adagrad")
+    ids = np.array([1], np.int32)
+    vals = np.ones((1, 4), np.float32)
+    assert table._server_table.merge_add_requests(
+        [(ids, vals, None), (ids, vals, None)]) is None
+    mv.shutdown()
+
+
+def test_array_and_kv_merge_semantics():
+    mv.init()
+    arr = mv.create_table("array", 8, np.float32)
+    ok = arr._server_table.merge_add_requests(
+        [(np.ones(8, np.float32), None), (np.full(8, 2.0, np.float32),
+                                          None)])
+    assert ok is not None
+    (total, _opt), size, consumed = ok
+    assert size == 8 and consumed == 2
+    np.testing.assert_array_equal(total, np.full(8, 3.0, np.float32))
+    # fused add+get (3-tuple) keeps per-request replies: refuse outright
+    # when first, stop the prefix when later
+    assert arr._server_table.merge_add_requests(
+        [(np.ones(8, np.float32), None, True),
+         (np.ones(8, np.float32), None)]) is None
+    kv = mv.create_table("kv")
+    ok = kv._server_table.merge_add_requests(
+        [([1, 2], [1.0, 2.0], None), ([2, 3], [5.0, 7.0], None)])
+    assert ok is not None
+    (keys, values, _opt), n, consumed = ok
+    assert n == 4 and consumed == 2
+    assert keys == [1, 2, 2, 3] and values == [1.0, 2.0, 5.0, 7.0]
+    assert kv._server_table.merge_add_requests(
+        [([1], [1.0, 2.0], None)]) is None  # misaligned pair lists
+    mv.shutdown()
+
+
+# -- gated servers stay per-message -------------------------------------------
+
+def test_gated_servers_never_fuse():
+    assert Server.fuses_adds
+    assert not DeterministicServer.fuses_adds
+    assert not SyncServer.fuses_adds
+    assert not SSPServer.fuses_adds
+
+
+def test_deterministic_server_unaffected_and_reproducible():
+    def run():
+        mv.set_flag("deterministic", True)
+        mv.init()
+        table = mv.create_table("matrix", num_row=16, num_col=4)
+        rng = np.random.default_rng(3)
+        for _ in range(6):
+            ids = rng.choice(16, 4, replace=False).astype(np.int32)
+            vals = rng.standard_normal((4, 4)).astype(np.float32)
+            table.add(vals, row_ids=ids)
+        table.finish_train()
+        final = np.asarray(table.get(), np.float32)
+        fused = Dashboard.counter_value("APPLY_FUSED_CALLS")
+        mv.shutdown()
+        return final, fused
+
+    final1, fused1 = run()
+    final2, fused2 = run()
+    assert fused1 == 0 and fused2 == 0
+    np.testing.assert_array_equal(final1, final2)
+
+
+# -- remote end-to-end under multi-producer load ------------------------------
+
+def test_remote_multi_producer_adds_fuse_and_sum_exactly():
+    mv.init(remote_workers=2, heartbeat_seconds=0)
+    table = mv.create_table("matrix", num_row=64, num_col=8)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    ids = np.arange(16, dtype=np.int32)
+    vals = np.ones((16, 8), np.float32)
+    n_producers, per = 4, 30
+
+    def push():
+        handles = []
+        for _ in range(per):
+            handles.append(rt.add_async(vals, row_ids=ids))
+            if len(handles) >= 16:
+                rt.wait(handles.pop(0))
+        for h in handles:
+            rt.wait(h)
+
+    threads = [threading.Thread(target=push) for _ in range(n_producers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_producers * per
+    out = np.asarray(rt.get(ids), np.float32)
+    np.testing.assert_array_equal(out, np.full((16, 8), float(total),
+                                               np.float32))
+    assert Dashboard.counter_value("APPLY_BATCHED_MSGS") > 0, \
+        "concurrent wire adds never fused"
+    client.close()
+    mv.shutdown()

@@ -321,6 +321,46 @@ def test_crash_point_recovery_exactly_once(point, tmp_path):
                 proc.wait(timeout=30)
 
 
+def test_crash_point_mid_batch_recovery_exactly_once(tmp_path):
+    """Kill -9 between a fused micro-batch's WAL appends and its apply
+    (the new apply-path crash window batching introduces): the child's
+    dispatcher is held until 3 Adds queue, so all 3 ride ONE fused apply
+    — every one is WAL-logged, none is applied or ACKed when the process
+    dies. After restart recovery the client's retransmits settle against
+    the WAL-seeded dedup window: zero acknowledged Adds lost, zero
+    double-applied."""
+    port = _free_port()
+    root = str(tmp_path / "d")
+    child = _spawn_child([str(port), root, "--crash-point=mid_batch",
+                          "--crash-at=1", "--batch-hold=3"])
+    child2 = None
+    try:
+        endpoint, table_id = _await_serving(child)
+        mv.set_flag("request_retry_seconds", 0.5)
+        mv.set_flag("reconnect_deadline_seconds", 90.0)
+        mv.set_flag("retry_base_seconds", 0.1)
+        mv.set_flag("heartbeat_seconds", 0.5)
+        client = mv.remote_connect(endpoint)
+        rt = client.table(table_id)
+        deltas = [np.full(8, float(2 ** k), np.float32) for k in range(4)]
+        handles = [rt.add_async(deltas[k]) for k in range(3)]
+        child.wait(timeout=60)
+        assert child.returncode == 9
+        child2 = _spawn_child([str(port), root, "--recover"])
+        _await_serving(child2)
+        for handle in handles:  # settle via reconnect-resume + dedup
+            rt.wait(handle)
+        rt.add(deltas[3])
+        final = np.asarray(rt.get(), np.float32)
+        np.testing.assert_array_equal(final, np.full(8, 15.0, np.float32))
+        client.close()
+    finally:
+        for proc in (child, child2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+
 # -- warm-standby failover ----------------------------------------------------
 
 @pytest.mark.parametrize("mode", ["async", "bsp"])

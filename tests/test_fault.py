@@ -125,6 +125,12 @@ def _push_deltas(fault_spec):
         mv.set_flag("fault_spec", fault_spec)
         mv.set_flag("fault_seed", SEED)
     mv.set_flag("request_retry_seconds", 0.3)
+    # per-message dispatch: this harness pins "one process_add call per
+    # Add" — the retry/dedup layer's invariant. The fused apply path
+    # folds concurrent Adds into fewer calls by design; its exactly-once
+    # story is covered by tests/test_apply_batch.py and the mid_batch
+    # crash point in tests/test_durable.py.
+    mv.set_flag("apply_batch_msgs", 0)
     mv.init(remote_workers=1)
     table = mv.create_table("array", 16, np.float32)
     endpoint = mv.serve("127.0.0.1:0")
@@ -326,6 +332,21 @@ def test_registration_survives_dropped_reply():
     mv.shutdown()
 
 
+def _sever_server_connections(rs):
+    """Simulate a peer-visible connection loss: close every accepted data
+    connection AND any shm channel riding on one — a ring segment does
+    not die with a TCP FIN (only with its peer process), so a 'network
+    blip' against an shm-negotiated client must sever both."""
+    net = rs._net
+    with net._conn_lock:
+        channels = list(net._shm_channels.values())
+        net._shm_channels.clear()
+    for ch in channels:
+        ch.close()
+    for conn in list(net._accepted):
+        conn.close()
+
+
 def test_client_reconnects_and_resumes_after_connection_loss():
     """A network blip (every server-side connection severed): the client
     reconnects under the same session, keeps its worker id, and the
@@ -339,8 +360,7 @@ def test_client_reconnects_and_resumes_after_connection_loss():
     rt.add(np.ones(8, np.float32))
     wid = client.worker_id
     rs = Zoo.instance().remote_server
-    for conn in list(rs._net._accepted):
-        conn.close()
+    _sever_server_connections(rs)
     time.sleep(0.2)
     rt.add(np.ones(8, np.float32))  # rides the recovered connection
     np.testing.assert_allclose(np.asarray(rt.get()), np.full(8, 2.0))
@@ -445,24 +465,35 @@ def test_fail_fast_flag_restores_old_posture():
     client = mv.remote_connect(endpoint)
     rt = client.table(table.table_id)
     rt.add(np.ones(4, np.float32))
+    # slow server gets: requests stay genuinely in flight, so the sever
+    # is guaranteed to catch pending ones — fail-fast means exactly
+    # those fail (an empty pending set failing "immediately" is vacuous)
+    orig_get = table._server_table.process_get
+    table._server_table.process_get = (
+        lambda req: (time.sleep(0.1), orig_get(req))[1])
     errors = []
+    handles = []
 
-    def doomed():
-        try:
-            for _ in range(100):
-                rt.get()
-                time.sleep(0.02)
-        except Exception as exc:  # noqa: BLE001
-            errors.append(exc)
+    def sender():
+        # NEVER waits: post-sever sends are what lets the TCP posture
+        # detect the loss (the shm transport detects it via the ring
+        # flags on its own); get_async swallows send errors into the
+        # recovery path, which with deadline 0 is immediate fail-all
+        for _ in range(30):
+            handles.append(rt.get_async())
+            time.sleep(0.02)
 
-    t = threading.Thread(target=doomed)
+    t = threading.Thread(target=sender)
     t.start()
     time.sleep(0.1)
-    rs = Zoo.instance().remote_server
-    for conn in list(rs._net._accepted):
-        conn.close()
+    _sever_server_connections(Zoo.instance().remote_server)
     t.join(timeout=20)
     assert not t.is_alive()
-    assert errors and isinstance(errors[0], (ConnectionError, RuntimeError))
+    try:
+        for h in handles:
+            rt.wait(h)
+    except (ConnectionError, RuntimeError) as exc:
+        errors.append(exc)
+    assert errors, "no pending request failed fast on connection loss"
     client.close()
     mv.shutdown()
