@@ -14,19 +14,24 @@
 #   make sharded        sharded-tier smoke: 2-shard group round-trip +
 #                       one-shard-down failover (router + layout RPC +
 #                       per-shard standby; docs/sharding.md)
+#   make replicas       read-replica smoke: budget-bound watermark-stamped
+#                       reads off a replica fleet + SIGKILL-a-replica
+#                       failover drill (docs/serving.md)
 #   make metrics-smoke  short remote-training session; assert the metrics
 #                       JSONL parses and key latency histograms are non-empty
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
 #   make apply-bench    apply-path micro-bench only: fused vs per-message
 #                       A/B, batch-size sweep, shm vs TCP RTT/throughput
+#   make read-bench     read-path A/B only: Zipf hot-key Gets, primary vs
+#                       replica vs replica+cache vs hedged
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos failover sharded metrics-smoke native test dryrun bench \
-	apply-bench clean
+.PHONY: check chaos failover sharded replicas metrics-smoke native test \
+	dryrun bench apply-bench read-bench clean
 
 check: native test dryrun bench
 
@@ -41,7 +46,8 @@ test: native
 chaos:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
 		tests/test_fault.py tests/test_durable.py tests/test_obs.py \
-		tests/test_shm.py tests/test_apply_batch.py -q \
+		tests/test_shm.py tests/test_apply_batch.py \
+		tests/test_replica.py -q \
 		-k "not crash_point and not failover" \
 		-p no:cacheprovider -p no:randomly
 
@@ -58,6 +64,12 @@ sharded:
 		-k "shard_group or layout_rpc" \
 		-p no:cacheprovider -p no:randomly
 
+replicas:
+	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
+		tests/test_replica.py -q \
+		-k "staleness_property or sharded_replica or admission" \
+		-p no:cacheprovider -p no:randomly
+
 dryrun:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8): ok')"
 
@@ -66,6 +78,9 @@ bench:
 
 apply-bench:
 	$(PYTHON) bench.py --apply-bench
+
+read-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --read-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

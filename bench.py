@@ -21,6 +21,7 @@ on scatter chains (measured); a dependent fetch cannot lie.
 """
 
 import json
+import threading
 import time
 
 import numpy as np
@@ -1032,6 +1033,178 @@ def bench_sharded(shards, rows=4096, cols=32, batch_rows=256,
     }
 
 
+class TrafficGen:
+    """Realistic serving-traffic generator (the ROADMAP scenario item's
+    first slice): Zipfian key skew over a permuted key space, a
+    read/write mix, and a target-QPS pacer. Deterministic per seed, so
+    every A/B leg replays the identical op stream."""
+
+    def __init__(self, key_space, zipf_s=1.2, read_fraction=0.95,
+                 target_qps=0.0, seed=0):
+        self.key_space = int(key_space)
+        self.zipf_s = float(zipf_s)
+        self.read_fraction = float(read_fraction)
+        self.target_qps = float(target_qps)
+        self._rng = np.random.default_rng(seed)
+        ranks = np.arange(1, self.key_space + 1, dtype=np.float64)
+        pmf = ranks ** -self.zipf_s
+        self._cdf = np.cumsum(pmf / pmf.sum())
+        # hot ranks land on scattered keys, not 0..k (a real keyspace's
+        # hot set is not contiguous)
+        self._perm = self._rng.permutation(self.key_space)
+        self._t0 = None
+        self._issued = 0
+
+    def draw_key(self):
+        return int(self._perm[int(np.searchsorted(
+            self._cdf, self._rng.random()))])
+
+    def next_op(self):
+        """-> ("get"|"add", key). Paces to target_qps when set (token
+        timing against the wall clock); 0 = unthrottled."""
+        if self.target_qps > 0:
+            if self._t0 is None:
+                self._t0 = time.perf_counter()
+            due = self._t0 + self._issued / self.target_qps
+            lag = due - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+        self._issued += 1
+        kind = ("get" if self._rng.random() < self.read_fraction
+                else "add")
+        return kind, self.draw_key()
+
+
+def bench_read(rows=8192, cols=32, seconds=5.0, zipf_s=1.6,
+               write_qps=50.0, n_readers=4, replicas=2):
+    """Read-path serving A/B (docs/serving.md): hot-key Zipfian Gets
+    against a 1-shard group with ``replicas`` serving read replicas,
+    under a concurrent write stream — aggregate Get/s for primary-only
+    vs replica vs replica+cache vs hedged routing, with the cache hit
+    rate and the proof that replica-served Gets consume ZERO primary
+    worker slots (the primary's Get-dispatch count during the replica
+    legs is fallbacks only). Readers dial the shard's primary directly
+    (one shard needs no router hop — the sharded router path is benched
+    by bench_sharded and drilled in tests/test_replica.py). Local CPU
+    children: this measures the serving machinery, not silicon."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.shard.group import ShardGroup
+
+    group = ShardGroup(
+        [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+        shards=1, replicas=replicas,
+        flags={"remote_workers": 8, "heartbeat_seconds": 0.2}).start()
+    result = {"read_key_space": rows, "read_zipf_s": zipf_s,
+              "read_write_qps": write_qps, "read_replicas": replicas,
+              "read_seconds": seconds}
+    try:
+        mv.set_flag("read_staleness_records", 1 << 30)
+        seed_client = group.connect(read_preference="primary")
+        table = seed_client.table(0)
+        base = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+        table.add(base, row_ids=np.arange(rows, dtype=np.int32))
+        # wait for the replicas to drain the seed adds
+        deadline = time.monotonic() + 60
+        for fleet in group.replica_endpoints:
+            for ep in fleet:
+                while time.monotonic() < deadline:
+                    probe = mv.watermark(ep)
+                    if probe["watermark"] >= 1 and probe["lag"] == 0:
+                        break
+                    time.sleep(0.1)
+
+        def primary_get_msgs():
+            hist = mv.stats(group.endpoints[0]).histogram(
+                "SERVER_PROCESS_GET_MSG")
+            return hist.count if hist else 0
+
+        def run_leg(name, preference, cache_bytes):
+            mv.set_flag("client_cache_bytes", cache_bytes)
+            mv.set_flag("read_lease_seconds", 5.0)
+            client = mv.remote_connect(
+                group.endpoints[0],
+                read_endpoints=group.replica_endpoints[0],
+                read_preference=preference)
+            leg_table = client.table(0)
+            hits0 = Dashboard.counter_value("READ_CACHE_HITS")
+            miss0 = Dashboard.counter_value("READ_CACHE_MISSES")
+            primary0 = primary_get_msgs()
+            gets = [0] * n_readers
+            stop = threading.Event()
+            errors = []
+
+            def reader(idx):
+                gen = TrafficGen(rows, zipf_s=zipf_s, read_fraction=1.0,
+                                 seed=100 + idx)
+                ids = np.zeros(1, np.int32)
+                while not stop.is_set():
+                    try:
+                        ids[0] = gen.draw_key()
+                        leg_table.get(row_ids=ids)
+                        gets[idx] += 1
+                    except Exception as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        return
+
+            def writer():
+                gen = TrafficGen(rows, zipf_s=zipf_s, read_fraction=0.0,
+                                 target_qps=write_qps, seed=7)
+                vals = np.ones((1, cols), np.float32)
+                ids = np.zeros(1, np.int32)
+                while not stop.is_set():
+                    ids[0] = gen.draw_key()
+                    try:
+                        table.add_async(vals, row_ids=ids)
+                    except Exception:  # noqa: BLE001 — writer is ambience
+                        return
+                    gen.next_op()  # pace
+
+            threads = [threading.Thread(target=reader, args=(i,))
+                       for i in range(n_readers)]
+            wthread = threading.Thread(target=writer)
+            for t in threads:
+                t.start()
+            wthread.start()
+            time.sleep(seconds)
+            stop.set()
+            for t in threads + [wthread]:
+                t.join(timeout=30)
+            client.close()
+            if errors:
+                raise errors[0]
+            total = sum(gets)
+            leg = {f"read_gets_per_sec_{name}": round(total / seconds, 1),
+                   f"read_primary_get_msgs_{name}":
+                       primary_get_msgs() - primary0}
+            hits = Dashboard.counter_value("READ_CACHE_HITS") - hits0
+            misses = Dashboard.counter_value("READ_CACHE_MISSES") - miss0
+            if cache_bytes and (hits + misses):
+                leg["read_cache_hit_rate"] = round(hits / (hits + misses),
+                                                   3)
+            return leg
+
+        legs = [("primary", "primary", 0),
+                ("replica", "replica", 0),
+                ("replica_cache", "replica", 64 << 20),
+                ("hedged", "hedged", 0)]
+        for name, preference, cache_bytes in legs:
+            result.update(run_leg(name, preference, cache_bytes))
+        mv.set_flag("client_cache_bytes", 0)
+        primary_gps = result["read_gets_per_sec_primary"]
+        if primary_gps:
+            result["read_speedup_replica_x"] = round(
+                result["read_gets_per_sec_replica"] / primary_gps, 2)
+            result["read_speedup_replica_cache_x"] = round(
+                result["read_gets_per_sec_replica_cache"] / primary_gps, 2)
+            result["read_speedup_hedged_x"] = round(
+                result["read_gets_per_sec_hedged"] / primary_gps, 2)
+        seed_client.close()
+    finally:
+        group.stop()
+    return result
+
+
 def probe_gbps(probe_mb=128):
     """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
     donated-pass loop, min-of-3. ~1s; the load thermometer every gated
@@ -1133,6 +1306,10 @@ def main():
         sharded = bench_sharded(int(os.environ.get("MV_BENCH_SHARDS", "2")))
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         sharded = {"sharded_error": repr(exc)[:300]}
+    try:
+        read = bench_read()
+    except Exception as exc:  # the spawn leg must not sink the TPU figures
+        read = {"read_bench_error": repr(exc)[:300]}
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
@@ -1155,6 +1332,7 @@ def main():
         **resnet,
         **mh,
         **sharded,
+        **read,
     }
     if pre_probe is not None:
         # shared-chip load probes (quiet ~760+ GB/s): the pre-run value
@@ -1191,6 +1369,11 @@ if __name__ == "__main__":
         # per-message A/B, producer sweep, shm vs TCP RTT
         print(json.dumps({"metric": "served_add_gbps",
                           **bench_apply_path()}))
+    elif "--read-bench" in sys.argv[1:]:
+        # read-path A/B only (`make read-bench`): Zipf hot-key Gets,
+        # primary vs replica vs replica+cache vs hedged
+        print(json.dumps({"metric": "read_gets_per_sec_replica_cache",
+                          **bench_read()}))
     else:
         shards = _parse_shards_arg(sys.argv[1:])
         if shards is not None:

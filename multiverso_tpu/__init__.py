@@ -256,11 +256,23 @@ def serve(endpoint: str = "127.0.0.1:0") -> str:
     return zoo.remote_server.endpoint
 
 
-def remote_connect(endpoint: str, timeout: float = 30.0):
+def remote_connect(endpoint: str, timeout: float = 30.0,
+                   read_endpoints: Optional[Sequence[str]] = None,
+                   read_preference: Optional[str] = None):
     """Connect to a serving process; returns a RemoteClient whose
-    ``.table(table_id)`` / ``.tables()`` give worker-table proxies."""
+    ``.table(table_id)`` / ``.tables()`` give worker-table proxies.
+
+    ``read_endpoints`` (serving read replicas of this primary, see
+    ``mv.warm_standby(...).serve_reads()``) plus a non-primary
+    ``read_preference`` (replica|hedged; default: the ``read_preference``
+    flag) route Gets through the read tier — bounded-staleness client
+    cache, budget-admitted replicas, transparent primary fallback
+    (docs/serving.md)."""
     from multiverso_tpu.runtime.remote import RemoteClient
-    return RemoteClient(endpoint, timeout=timeout)
+    return RemoteClient(endpoint, timeout=timeout,
+                        read_endpoints=(list(read_endpoints)
+                                        if read_endpoints else None),
+                        read_preference=read_preference)
 
 
 def stats(endpoint: str, timeout: float = 10.0):
@@ -268,9 +280,20 @@ def stats(endpoint: str, timeout: float = 10.0):
     dashboard — monitors, counters, gauges, and latency histograms with
     caller-side p50/p95/p99 — without taking a worker slot. Returns a
     :class:`~multiverso_tpu.obs.metrics.StatsSnapshot`; metric catalog in
-    ``docs/observability.md``."""
+    ``docs/observability.md``. Works against primaries AND serving read
+    replicas (their read listener answers the same probe)."""
     from multiverso_tpu.runtime.remote import fetch_stats
     return fetch_stats(endpoint, timeout=timeout)
+
+
+def watermark(endpoint: str, timeout: float = 10.0):
+    """Watermark probe (read-replica tier): ``{"role", "watermark",
+    "primary_watermark", "lag"}`` for any serving endpoint — a primary
+    reports its WAL append sequence, a read replica its replay sequence
+    and how many records it trails its primary by. Slot-free, like
+    ``mv.stats`` (docs/serving.md)."""
+    from multiverso_tpu.runtime.remote import fetch_watermark
+    return fetch_watermark(endpoint, timeout=timeout)
 
 
 # -- sharded serving tier (multiverso_tpu/shard/, docs/sharding.md) ----------
@@ -319,17 +342,33 @@ def shard_connect(endpoints: Any = None, timeout: float = 30.0):
               "; ".join(errors))
 
 
-def stats_all(endpoints: Any, timeout: float = 10.0):
+def stats_all(endpoints: Any, timeout: float = 10.0,
+              replicas: Optional[Sequence[Sequence[str]]] = None):
     """Fan ``mv.stats`` across a shard group and merge: counters summed,
     histograms merged by bucket addition (quantiles compute on the union
     of the members' exact counts), with per-shard sub-views kept on
     ``.shards``. ``endpoints``: list of host:port, a comma-separated
-    string, or a :class:`~multiverso_tpu.shard.group.ShardGroup`."""
+    string, or a :class:`~multiverso_tpu.shard.group.ShardGroup` (whose
+    read-replica fleets are probed automatically). ``replicas`` — one
+    endpoint list per shard — adds per-replica sub-views on
+    ``.replicas`` (a dict ``endpoint -> StatsSnapshot``), merged into
+    the totals alongside the primaries (replica replay-lag gauges
+    REPLICA_WATERMARK / REPLICA_LAG_RECORDS live there)."""
     from multiverso_tpu.obs.metrics import merge_stats
     from multiverso_tpu.shard.partition import parse_shard_endpoints
+    if replicas is None:
+        replicas = getattr(endpoints, "replica_endpoints", None)
     endpoints = getattr(endpoints, "endpoints", endpoints)
-    return merge_stats(stats(e, timeout=timeout)
-                       for e in parse_shard_endpoints(endpoints))
+    snaps = [stats(e, timeout=timeout)
+             for e in parse_shard_endpoints(endpoints)]
+    replica_snaps = {}
+    for fleet in (replicas or []):
+        for endpoint in fleet:
+            replica_snaps[endpoint] = stats(endpoint, timeout=timeout)
+    merged = merge_stats(snaps + list(replica_snaps.values()))
+    merged.shards = snaps  # primaries only; replicas get their own view
+    merged.replicas = replica_snaps
+    return merged
 
 
 def stop_serving() -> None:
@@ -377,14 +416,20 @@ def wal_writer():
 
 def warm_standby(primary_endpoint: str, service_endpoint: str,
                  tables: Optional[Sequence[Any]] = None,
-                 lease_seconds: Optional[float] = None):
+                 lease_seconds: Optional[float] = None,
+                 takeover: bool = True):
     """Start a warm standby tailing ``primary_endpoint``'s WAL; on primary
     lease expiry it binds ``service_endpoint`` and clients fail over
     transparently (durable/standby.py). Returns the started
-    :class:`~multiverso_tpu.durable.standby.WarmStandby`."""
+    :class:`~multiverso_tpu.durable.standby.WarmStandby` — call
+    ``.serve_reads()`` on it to promote it into a serving read replica
+    (watermark-stamped slot-free Gets, docs/serving.md).
+    ``takeover=False`` builds a pure read replica: several can tail one
+    primary without racing to bind its endpoint when it dies."""
     from multiverso_tpu.durable.standby import WarmStandby
     return WarmStandby(primary_endpoint, service_endpoint, tables=tables,
-                       lease_seconds=lease_seconds).start()
+                       lease_seconds=lease_seconds,
+                       takeover=takeover).start()
 
 
 # -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------

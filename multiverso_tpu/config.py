@@ -308,6 +308,46 @@ define_string("shard_endpoints", "",
               "group — mv.shard_connect() bootstraps the layout manifest "
               "from the first reachable member; entries are validated "
               "fail-fast")
+# Read-replica serving tier (durable/standby.py serve loop + runtime/read.py
+# client-side cache and routing; docs/serving.md).
+define_int("replicas", 0,
+           "serving read replicas per shard in a shard group (each tails "
+           "the primary's WAL and answers slot-free watermark-stamped "
+           "Gets); 0 = none. Implies durability (replication tails the "
+           "WAL)")
+define_string("read_preference", "primary",
+              "where a remote client's Gets go: primary (every Get takes "
+              "a primary worker slot — the pre-replica behavior), replica "
+              "(round-robin over read replicas whose replay watermark "
+              "satisfies the staleness budget, falling back to the "
+              "primary when none qualifies), hedged (replica, plus a "
+              "second endpoint fired after a p95-derived delay; first "
+              "reply wins, the loser is cancelled)")
+define_int("read_staleness_records", 1024,
+           "staleness budget for replica-served Gets, in WAL records: a "
+           "replica may answer only while its replay watermark is within "
+           "this many records of the primary's append watermark "
+           "(generalized SSP bound — clocks become reads); -1 = unbounded "
+           "(any live replica answers)")
+define_int("client_cache_bytes", 0,
+           "client-side bounded-staleness read cache capacity (bytes, "
+           "LRU by table/key): a cached Get is served without touching "
+           "the wire while its watermark stays within "
+           "read_staleness_records of the newest watermark the client "
+           "has observed AND its lease (read_lease_seconds) is live. "
+           "0 disables the cache")
+define_double("read_lease_seconds", 0.25,
+              "client cache entry lease: the blind window during which a "
+              "cached read may be re-served without any wire contact "
+              "(watermark invalidation still applies the instant a newer "
+              "watermark is observed)")
+define_double("read_timeout_seconds", 1.0,
+              "deadline for one replica read attempt before the client "
+              "falls back (next replica, then primary); also the cap on "
+              "the hedged second-fire delay")
+define_double("read_hedge_ms", 0.0,
+              "hedged-read second-fire delay in milliseconds; 0 derives "
+              "it from the p95 of recent replica read latencies")
 define_string("wal_sync", "batch",
               "WAL durability barrier per append: none (buffered — the "
               "tail can be lost even to a process crash), batch (flush to "

@@ -190,6 +190,16 @@ class WalWriter:
         self._observers: List[Callable] = []
         self._lock = threading.Lock()
         self._closed = False
+        # append watermark: records appended by THIS writer, monotonic
+        # within the process. The read-replica tier's staleness unit: the
+        # primary advertises it on replies/heartbeats, each replicated
+        # record carries its own sequence, and a replica's replay
+        # watermark is the last sequence it applied (docs/serving.md).
+        # Starts at 0 per incarnation — replicas adopt the primary's
+        # stamps at subscribe time, and clients treat a watermark
+        # REGRESSION (new primary after failover/restart) as a full
+        # cache flush, so cross-incarnation continuity is not required.
+        self.seq = 0
         # replay debt: bytes appended since the last committed snapshot
         # (restart recovery replays roughly this much). Starts at 0 on a
         # resumed log — the gauge tracks THIS process's contribution.
@@ -215,14 +225,16 @@ class WalWriter:
         return stream
 
     def append(self, req_id: int, worker: int, table_id: int, msg_id: int,
-               blobs: List[np.ndarray]) -> None:
+               blobs: List[np.ndarray]) -> int:
+        """Append one record; returns its sequence number (the append
+        watermark after this record)."""
         t0 = time.perf_counter()
         record = _encode_record(req_id, worker, msg_id, blobs)
         with self._lock:
             if self._closed:
                 log.error("wal: append after close (req %d dropped from "
                           "the log; the table still applies it)", req_id)
-                return
+                return self.seq
             stream = self._stream_for(table_id)
             stream.write(record)
             if self.sync == "batch":
@@ -234,16 +246,19 @@ class WalWriter:
                 # distribution separates disk stalls from encode cost
                 observe("WAL_FSYNC_SECONDS", time.perf_counter() - t_sync)
             self._backlog_bytes += len(record)
+            self.seq += 1
+            seq = self.seq
             observers = list(self._observers)
         count("WAL_APPENDS")
         observe("WAL_APPEND_SECONDS", time.perf_counter() - t0)
         gauge_set("WAL_BACKLOG_BYTES", self._backlog_bytes)
         hop(req_id, "wal_append")
         for observer in observers:
-            observer(req_id, worker, table_id, msg_id, blobs)
+            observer(seq, req_id, worker, table_id, msg_id, blobs)
+        return seq
 
     def add_observer(self, fn: Callable) -> None:
-        """``fn(req_id, worker, table_id, msg_id, blobs)`` after each
+        """``fn(seq, req_id, worker, table_id, msg_id, blobs)`` after each
         durable append — the replication fan-out seam."""
         with self._lock:
             self._observers.append(fn)

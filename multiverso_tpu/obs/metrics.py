@@ -200,6 +200,10 @@ class StatsSnapshot:
         # per-member sub-views when this snapshot is a merged shard-group
         # view (mv.stats_all / merge_stats); empty for a single server
         self.shards: List["StatsSnapshot"] = []
+        # per-replica sub-views (endpoint -> StatsSnapshot) when the
+        # group runs serving read replicas; the replica replay-lag gauges
+        # (REPLICA_WATERMARK / REPLICA_LAG_RECORDS) live in these
+        self.replicas: Dict[str, "StatsSnapshot"] = {}
 
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._histograms.get(name)

@@ -26,11 +26,18 @@ class MsgType(enum.IntEnum):
     # server-bound requests (positive, < 32)
     Request_Get = 1
     Request_Add = 2
+    # slot-free read (read-replica tier, durable/standby.py +
+    # runtime/read.py): a Get that takes NO worker slot, NO lease and NO
+    # dedup entry — served by replicas and by the primary's admin path,
+    # with the request's staleness budget and the reply's replay
+    # watermark riding the header's watermark field
+    Request_Read = 3
     Server_Execute = 30  # run a callable on the dispatcher thread (admin)
     Server_Finish_Train = 31
     # worker-bound replies (negative)
     Reply_Get = -1
     Reply_Add = -2
+    Reply_Read = -3
     Reply_Error = -5  # request failed server-side / peer connection lost
     # control plane (>= 32 request, <= -32 reply)
     Control_Barrier = 33
@@ -62,6 +69,12 @@ class MsgType(enum.IntEnum):
     # reach the mailbox/dispatcher.
     Control_Shm = 41
     Control_Reply_Shm = -41
+    # watermark probe (read-replica tier): any serving process answers
+    # with its role and watermark position — primary: WAL append seq;
+    # replica: replay seq + the primary append seq it has observed —
+    # slot-free like the stats probe
+    Control_Watermark = 42
+    Control_Reply_Watermark = -42
 
     @property
     def is_server_bound(self) -> bool:
@@ -99,6 +112,12 @@ class Message:
     # control traffic). Distinct from msg_id, which stays the reply
     # correlation key.
     req_id: int = 0
+    # WAL-record position (read-replica tier, docs/serving.md). On a
+    # reply/record frame: the sender's watermark — a primary stamps its
+    # append sequence, a replica its replay sequence, a Control_Wal_Record
+    # the record's own sequence (gap detection). On a Request_Read: the
+    # client's staleness budget in records (-1 = unbounded). -1 elsewhere.
+    watermark: int = -1
     data: List[Any] = field(default_factory=list)
 
     def create_reply(self) -> "Message":

@@ -48,13 +48,15 @@ _MAGIC = 0x4D565450  # 'MVTP'
 # req_id field (idempotent replay, fault/retry.py); v3 grew payload_len +
 # a CRC32 over the blob section, so a corrupted frame is detected and
 # DISCARDED (the length keeps the stream in sync; retransmit + the dedup
-# window recover the frame) instead of desyncing on a garbled blob size.
+# window recover the frame) instead of desyncing on a garbled blob size;
+# v4 grew the watermark field (read-replica tier: WAL record sequence on
+# replies/records, staleness budget on Request_Read frames).
 # Both sides of every deployment ship from this repo, so a mismatch is a
 # config error and the connection is dropped loudly rather than negotiated.
-_VERSION = 3
-# magic, version, channel, src, dst, type, table, msg_id, req_id, nblobs,
-# payload_len, crc32(payload)
-_HEADER = struct.Struct("<IBBiiiiqqiqI")
+_VERSION = 4
+# magic, version, channel, src, dst, type, table, msg_id, req_id,
+# watermark, nblobs, payload_len, crc32(payload)
+_HEADER = struct.Struct("<IBBiiiiqqqiqI")
 _BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
 
 # One vectored syscall carries at most this many iovec segments — well
@@ -373,8 +375,8 @@ class TcpNet:
                 payload_len += blob_bytes
         segments[0] = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src,
                                    msg.dst, int(msg.type), msg.table_id,
-                                   msg.msg_id, msg.req_id, len(msg.data),
-                                   payload_len, crc)
+                                   msg.msg_id, msg.req_id, msg.watermark,
+                                   len(msg.data), payload_len, crc)
         observe("FRAME_ENCODE_SECONDS", time.perf_counter() - t0)
         return segments, _HEADER.size + payload_len
 
@@ -679,7 +681,7 @@ class TcpNet:
         :class:`_WireDesync` on an unparsable header."""
         head = read(_HEADER.size)
         (magic, version, channel, src, dst, mtype, table_id, msg_id,
-         req_id, nblobs, payload_len, crc) = _HEADER.unpack(head)
+         req_id, watermark, nblobs, payload_len, crc) = _HEADER.unpack(head)
         if magic != _MAGIC:
             log.error("net: bad frame magic %x", magic)
             raise _WireDesync("bad frame magic")
@@ -718,7 +720,7 @@ class TcpNet:
         hop(req_id, "net_recv")
         msg = Message(src=src, dst=dst, type=MsgType(mtype),
                       table_id=table_id, msg_id=msg_id,
-                      req_id=req_id, data=blobs)
+                      req_id=req_id, watermark=watermark, data=blobs)
         msg._wire_channel = channel
         return msg
 

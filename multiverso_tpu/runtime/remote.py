@@ -95,6 +95,7 @@ class _NetCompletion:
         t = self._template
         msg = Message(src=t.dst, dst=t.src, type=msg_type,
                       table_id=t.table_id, msg_id=t.msg_id, req_id=t.req_id,
+                      watermark=self._server.append_watermark(),
                       data=wire.encode(payload, compress=self._compress))
         self._server._dedup_store(t.req_id, msg)
         hop(t.req_id, "reply_sent")
@@ -110,6 +111,41 @@ class _NetCompletion:
                       if self._template.type == MsgType.Request_Get
                       else MsgType.Reply_Add)
         self._reply(reply_type, result)
+
+    def fail(self, error: BaseException) -> None:
+        self._reply(MsgType.Reply_Error, repr(error))
+
+
+class _ReadCompletion:
+    """Completion for a slot-free Request_Read: replies Reply_Read stamped
+    with the primary's append watermark. No dedup entry — reads are
+    idempotent, a replayed read just re-serves."""
+
+    __slots__ = ("_server", "_conn", "_template", "_compress")
+
+    def __init__(self, server: "RemoteServer", conn, template: Message,
+                 compress: bool) -> None:
+        self._server = server
+        self._conn = conn
+        self._template = template
+        self._compress = compress
+
+    def _reply(self, msg_type: MsgType, payload: Any) -> None:
+        t = self._template
+        msg = Message(src=t.dst, dst=t.src, type=msg_type,
+                      table_id=t.table_id, msg_id=t.msg_id, req_id=t.req_id,
+                      watermark=self._server.append_watermark(),
+                      data=wire.encode(payload, compress=self._compress))
+        hop(t.req_id, "read_reply_sent")
+        try:
+            self._server._net.send_via(self._conn, msg)
+        except OSError as exc:
+            log.error("remote: read reply failed: %r (the client falls "
+                      "back to another endpoint)", exc)
+
+    def done(self, result: Any) -> None:
+        count("READS_SERVED_PRIMARY")
+        self._reply(MsgType.Reply_Read, result)
 
     def fail(self, error: BaseException) -> None:
         self._reply(MsgType.Reply_Error, repr(error))
@@ -153,6 +189,14 @@ class RemoteServer:
         # only after every member has bound its endpoint)
         self.layout: Optional[Dict[str, Any]] = None
         self.layout_path: str = ""
+
+    def append_watermark(self) -> int:
+        """The primary's WAL append sequence (-1 when serving without
+        durability — no staleness unit exists then). Reads a plain int
+        written on the dispatcher thread; safe from any thread."""
+        server = self._zoo.server
+        wal = server.wal if server is not None else None
+        return int(wal.seq) if wal is not None else -1
 
     def serve(self, endpoint: str = "127.0.0.1:0") -> str:
         """Bind + start the pump; returns the dialable endpoint."""
@@ -232,20 +276,22 @@ class RemoteServer:
                 self._dedup.popitem(last=False)
 
     # -- warm-standby replication (durable/standby.py) -----------------------
-    def _replicate_record(self, req_id: int, worker: int, table_id: int,
-                          msg_id: int, blobs) -> None:
+    def _replicate_record(self, seq: int, req_id: int, worker: int,
+                          table_id: int, msg_id: int, blobs) -> None:
         """WAL observer: forward one durable record to every subscribed
         standby. Runs on the dispatcher thread right after the append, so
         a record the primary ACKs was already written to each standby's
         socket before the ACK frame — the kernel delivers it even if the
-        primary dies the next instant."""
+        primary dies the next instant. Each record carries its append
+        sequence so replicas track their replay watermark and DETECT
+        stream gaps (a missing sequence forces a resubscribe)."""
         with self._standby_lock:
             conns = list(self._standbys)
         for conn in conns:
             msg = Message(src=worker, dst=-1,
                           type=MsgType.Control_Wal_Record,
                           table_id=table_id, msg_id=msg_id, req_id=req_id,
-                          data=list(blobs))
+                          watermark=seq, data=list(blobs))
             try:
                 # flush: the record must reach the standby's socket before
                 # the client's ACK is even queued — with the coalescing
@@ -289,14 +335,21 @@ class RemoteServer:
                          if isinstance(m, Message)
                          and m.type == MsgType.Reply_Add]
             with self._standby_lock:
-                self._standbys.append(msg._conn)
-            return tables, dedup
+                # idempotent: a gap-triggered resubscribe arrives over the
+                # SAME live connection — double-adding it would double
+                # every later record
+                if msg._conn not in self._standbys:
+                    self._standbys.append(msg._conn)
+            # the snapshot's watermark, read inside the serialized block:
+            # every record the standby will see next has seq > this
+            return tables, dedup, int(wal.seq)
 
-        tables, dedup = self._zoo.server.run_serialized(transfer)
+        tables, dedup, watermark = self._zoo.server.run_serialized(transfer)
         self._net.send_via(msg._conn, Message(
             src=0, dst=msg.src, type=MsgType.Control_Reply_Replicate,
-            msg_id=msg.msg_id, req_id=msg.req_id,
-            data=wire.encode({"tables": tables, "dedup": dedup})))
+            msg_id=msg.msg_id, req_id=msg.req_id, watermark=watermark,
+            data=wire.encode({"tables": tables, "dedup": dedup,
+                              "watermark": watermark})))
         log.info("remote: standby subscribed (%d table(s), %d dedup "
                  "seed(s) transferred)", len(tables), len(dedup))
         self._ensure_standby_heartbeats()
@@ -316,17 +369,28 @@ class RemoteServer:
         self._standby_hb.start()
 
     def _standby_heartbeat_loop(self, period: float) -> None:
-        beat = Message(src=0, dst=-1, type=MsgType.Control_Heartbeat)
         while not self._standby_hb_stop.wait(period):
-            with self._standby_lock:
-                conns = list(self._standbys)
-            for conn in conns:
-                try:
-                    self._net.send_via(conn, beat)
-                except OSError:
-                    with self._standby_lock:
-                        if conn in self._standbys:
-                            self._standbys.remove(conn)
+            try:
+                # a fresh frame per beat: the watermark stamp keeps the
+                # replicas' view of the primary's append position current
+                # while the WAL idles — the lag a replica admits reads
+                # against stays honest
+                beat = Message(src=0, dst=-1,
+                               type=MsgType.Control_Heartbeat,
+                               watermark=self.append_watermark())
+                with self._standby_lock:
+                    conns = list(self._standbys)
+                for conn in conns:
+                    try:
+                        self._net.send_via(conn, beat)
+                    except OSError:
+                        with self._standby_lock:
+                            if conn in self._standbys:
+                                self._standbys.remove(conn)
+            except Exception as exc:  # noqa: BLE001 — a dead heartbeat
+                # thread starves every standby's lease into a FALSE
+                # failover; log and keep beating
+                log.error("remote: standby heartbeat tick failed: %r", exc)
 
     # -- pump ---------------------------------------------------------------
     def _pump(self) -> None:
@@ -357,6 +421,12 @@ class RemoteServer:
             return
         if msg.type == MsgType.Control_Layout:
             self._reply_layout(msg)
+            return
+        if msg.type == MsgType.Control_Watermark:
+            self._reply_watermark(msg)
+            return
+        if msg.type == MsgType.Request_Read:
+            self._serve_read(msg, compress)
             return
         if msg.type == MsgType.Control_Register:
             if not self._replayed(msg):
@@ -395,6 +465,34 @@ class RemoteServer:
                             msg.data)
         hop(msg.req_id, "dispatch_enqueue")
         self._zoo.server.send(forward)
+
+    def _serve_read(self, msg: Message, compress: bool) -> None:
+        """Request_Read on the PRIMARY: a slot-free Get — no worker slot,
+        no lease, no dedup entry. The request rides the dispatcher queue
+        as an administrative Get (src=-1 bypasses every round gate), so
+        it serializes with applies, and the Reply_Read is stamped with the
+        append watermark at reply time. The primary is trivially "fresh",
+        so the request's staleness budget is always satisfied here — this
+        is the fallback target when no replica qualifies."""
+        request = wire.decode(msg.data)
+        completion = _ReadCompletion(self, msg._conn, msg, compress)
+        hop(msg.req_id, "dispatch_enqueue")
+        self._zoo.server.send(Message(
+            src=-1, dst=-1, type=MsgType.Request_Get,
+            table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
+            data=[request, completion]))
+
+    def _reply_watermark(self, msg: Message) -> None:
+        """Control_Watermark: this process's position in the WAL stream —
+        slot-free like the stats probe (an operator asking 'how stale is
+        this endpoint' must get an answer even when every slot is
+        taken)."""
+        watermark = self.append_watermark()
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Watermark,
+            msg_id=msg.msg_id, req_id=msg.req_id, watermark=watermark,
+            data=wire.encode({"role": "primary", "watermark": watermark,
+                              "primary_watermark": watermark, "lag": 0})))
 
     def _reply_stats(self, msg: Message) -> None:
         """Control_Stats: ship this process's full dashboard — monitors,
@@ -604,6 +702,16 @@ def control_probe(endpoint: str, request_type: MsgType,
     return wire.decode(reply.data)
 
 
+def fetch_watermark(endpoint: str, timeout: float = 10.0) -> Dict[str, Any]:
+    """One-shot watermark probe: ``{"role": "primary"|"replica",
+    "watermark": <applied/append seq>, "primary_watermark": <append seq
+    observed>, "lag": <records behind>}`` — the staleness position of any
+    serving endpoint (primary or read replica), slot-free."""
+    return control_probe(endpoint, MsgType.Control_Watermark,
+                         MsgType.Control_Reply_Watermark,
+                         timeout=timeout, what="watermark")
+
+
 def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
     """One-shot live stats RPC: the server's dashboard as a
     :class:`StatsSnapshot` (histograms rebuilt from their bucket arrays,
@@ -656,9 +764,19 @@ class RemoteClient:
     (``reconnect_deadline_seconds``); the server's dedup window keeps every
     replay idempotent. A maintenance thread renews the worker's lease with
     heartbeats. ``reconnect_deadline_seconds=0`` restores the fail-fast
-    posture: any connection loss fails all pending requests immediately."""
+    posture: any connection loss fails all pending requests immediately.
 
-    def __init__(self, endpoint: str, timeout: float = 30.0) -> None:
+    Read tier (``docs/serving.md``): with ``read_endpoints`` (serving
+    read replicas) and a non-primary ``read_preference``, Gets route
+    through :class:`~multiverso_tpu.runtime.read.ReadRouter` — client
+    cache, then budget-admitted replicas (hedged optionally), then the
+    primary as the transparent fallback. Adds always go to the primary.
+    Pipelined tables bypass the tier (their Gets depend on per-worker
+    server state a replica does not track)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 read_endpoints: Optional[List[str]] = None,
+                 read_preference: Optional[str] = None) -> None:
         self._net = make_net()
         self._net.rank = -1
         self._net.connect([endpoint])
@@ -676,6 +794,10 @@ class RemoteClient:
         self._stop_maint = threading.Event()
         self._hb_period = float(config.get_flag("heartbeat_seconds"))
         self._rto = float(config.get_flag("request_retry_seconds"))
+        # set BEFORE the pump starts (the pump observes reply watermarks
+        # through it); the router itself is built after registration
+        self._read_router = None
+        self._read_ok: Dict[int, bool] = {}
         self._pump_thread = threading.Thread(
             target=self._pump, daemon=True, name="mv-remote-client")
         self._pump_thread.start()
@@ -688,6 +810,17 @@ class RemoteClient:
             self._net.finalize()
             raise
         self._channel = RemoteChannel(self)
+        preference = (read_preference if read_preference is not None
+                      else str(config.get_flag("read_preference")))
+        if read_endpoints and preference != "primary":
+            from multiverso_tpu.runtime.read import ReadRouter
+
+            def primary_submit(table_id, request, completion):
+                self._send(table_id, MsgType.Request_Get, request,
+                           next_msg_id(), completion, direct=True)
+
+            self._read_router = ReadRouter(list(read_endpoints), preference,
+                                           primary_submit)
         self._start_maintenance()
 
     # -- lifecycle -----------------------------------------------------------
@@ -696,6 +829,8 @@ class RemoteClient:
             return
         self._closed = True
         self._stop_maint.set()
+        if self._read_router is not None:
+            self._read_router.close()
         try:
             self._net.send(Message(src=self.worker_id, dst=0,
                                    type=MsgType.Control_Deregister,
@@ -746,8 +881,31 @@ class RemoteClient:
         self.directory = info["tables"]
 
     # -- request path --------------------------------------------------------
+    def _read_tier_ok(self, table_id: int) -> bool:
+        """Tables whose Gets may route through the read tier: everything
+        except pipelined tables (their Gets read per-worker server state
+        — what THIS worker has seen — which replicas don't track)."""
+        ok = self._read_ok.get(table_id)
+        if ok is None:
+            spec = next((s for s in self.directory
+                         if int(s.get("table_id", -1)) == int(table_id)),
+                        None)
+            ok = spec is not None and not spec.get("is_pipelined", False)
+            self._read_ok[table_id] = ok
+        return ok
+
     def _send(self, table_id: int, msg_type: MsgType, request: Any,
-              msg_id: int, completion: Optional[Completion]) -> None:
+              msg_id: int, completion: Optional[Completion],
+              direct: bool = False) -> None:
+        if self._read_router is not None and not direct:
+            if (msg_type == MsgType.Request_Get and completion is not None
+                    and self._read_tier_ok(table_id)):
+                self._read_router.submit_get(table_id, request, completion)
+                return
+            if msg_type == MsgType.Request_Add:
+                # this client just changed the table: its cached reads of
+                # it are suspect (write-through invalidation)
+                self._read_router.note_local_write(table_id)
         data = [] if request is None and msg_type not in (
             MsgType.Request_Get, MsgType.Request_Add) else wire.encode(
                 request, compress=self._compress)
@@ -785,6 +943,11 @@ class RemoteClient:
             if msg is None:
                 self._fail_all(ConnectionError("remote client shut down"))
                 return
+            if self._read_router is not None and msg.watermark >= 0:
+                # primary replies advertise the append watermark: the
+                # cache horizon advances (and a regression — a new
+                # primary incarnation — flushes it)
+                self._read_router.observe_primary_watermark(msg.watermark)
             with self._lock:
                 completion = self._pending.pop(msg.msg_id, None)
                 flight = self._inflight.pop(msg.msg_id, None)

@@ -10,6 +10,13 @@ announces the bound endpoint via ``<base_dir>/shard<k>.endpoint``.
 (:mod:`multiverso_tpu.durable.standby`): replicate the primary, take over
 its endpoint on lease expiry, announce via ``standby<k>.tookover``.
 
+``--replica <i> --primary <endpoint>`` runs serving read replica ``i``
+of the shard (a WarmStandby promoted with ``serve_reads()``): tail the
+WAL, answer slot-free watermark-stamped Gets, announce the read endpoint
+via ``replica<k>.<i>.endpoint``. With ``--takeover`` the replica also
+holds the failover role (replica 0 when the group runs no dedicated
+standby) and announces a takeover via ``standby<k>.tookover``.
+
 ``--recover`` replays this shard's WAL before serving — the per-shard
 restart-recovery path (docs/fault_tolerance.md §7, per shard).
 """
@@ -56,6 +63,10 @@ def main(argv=None) -> int:
     parser.add_argument("--spec", required=True)
     parser.add_argument("--shard", type=int, required=True)
     parser.add_argument("--standby", action="store_true")
+    parser.add_argument("--replica", type=int, default=-1,
+                        help="serving read-replica index (>= 0)")
+    parser.add_argument("--takeover", action="store_true",
+                        help="this replica also holds the failover role")
     parser.add_argument("--primary", default="")
     parser.add_argument("--recover", action="store_true")
     parser.add_argument("--port", type=int, default=0)
@@ -73,7 +84,9 @@ def main(argv=None) -> int:
     flags = dict(spec.get("flags", {}))
     flags["ps_role"] = "server"
     if spec.get("wal_root"):
-        suffix = "-standby" if args.standby else ""
+        suffix = ("-standby" if args.standby
+                  else f"-replica{args.replica}" if args.replica >= 0
+                  else "")
         flags["wal_dir"] = shard_wal_dir(spec["wal_root"], shard) + suffix
     mv.init(**flags)
     tables = _build_tables(mv, spec, shard)
@@ -90,6 +103,21 @@ def main(argv=None) -> int:
             remote.layout_path = spec.get("layout_path", "")
         _write_atomic(os.path.join(base_dir, f"standby{shard}.tookover"),
                       standby.endpoint or "")
+    elif args.replica >= 0:
+        standby = mv.warm_standby(args.primary, args.primary, tables=tables,
+                                  takeover=args.takeover)
+        read_ep = standby.serve_reads(
+            f"{spec.get('host', '127.0.0.1')}:0")
+        _write_atomic(os.path.join(
+            base_dir, f"replica{shard}.{args.replica}.endpoint"), read_ep)
+        if args.takeover:
+            standby.took_over.wait()
+            remote = Zoo.instance().remote_server
+            if remote is not None:
+                remote.layout_path = spec.get("layout_path", "")
+            _write_atomic(os.path.join(base_dir,
+                                       f"standby{shard}.tookover"),
+                          standby.endpoint or "")
     else:
         if args.recover:
             mv.durable_recover(tables)

@@ -42,6 +42,7 @@ class ShardGroup:
                  shards: Optional[int] = None,
                  base_dir: Optional[str] = None,
                  standby: bool = False,
+                 replicas: Optional[int] = None,
                  durable: Optional[bool] = None,
                  partitioner: Optional[str] = None,
                  flags: Optional[Dict[str, Any]] = None,
@@ -53,8 +54,19 @@ class ShardGroup:
                       "the -shards flag)")
         self.num_shards = int(shards)
         self.standby = bool(standby)
-        # standby replication tails the WAL — durability is implied
-        self.durable = bool(durable) if durable is not None else self.standby
+        # serving read replicas per shard (read-replica tier): each tails
+        # its primary's WAL and answers slot-free watermark-stamped Gets.
+        # With standby=False, replica 0 doubles as the failover standby
+        # (takeover role); with standby=True the dedicated standby keeps
+        # the takeover role and replicas only serve reads.
+        self.num_replicas = int(replicas if replicas is not None
+                                else config.get_flag("replicas"))
+        if self.num_replicas < 0:
+            log.fatal("ShardGroup needs replicas >= 0, got %d",
+                      self.num_replicas)
+        # standby/replica replication tails the WAL — durability is implied
+        self.durable = (bool(durable) if durable is not None
+                        else (self.standby or self.num_replicas > 0))
         part_flag = validate_partitioner_flag(
             partitioner if partitioner is not None
             else config.get_flag("shard_partitioner"))
@@ -67,9 +79,11 @@ class ShardGroup:
         self.layout_path = os.path.join(self.base_dir, "layout.json")
         self.spec_path = os.path.join(self.base_dir, "group.json")
         self.endpoints: List[str] = []
+        self.replica_endpoints: List[List[str]] = []
         self.layout: Optional[ShardLayout] = None
         self._primaries: List[subprocess.Popen] = []
         self._standbys: List[Optional[subprocess.Popen]] = []
+        self._replicas: List[List[subprocess.Popen]] = []
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, timeout: float = 240.0) -> "ShardGroup":
@@ -87,9 +101,27 @@ class ShardGroup:
             self._primaries.append(self._spawn(k))
         self.endpoints = [self._await_file(f"shard{k}.endpoint", k, deadline)
                           for k in range(self.num_shards)]
+        # replicas spawn after the primaries (they subscribe to them) but
+        # BEFORE the manifest publish, so the layout clients bootstrap
+        # from already names every read endpoint
+        if self.num_replicas > 0:
+            for k in range(self.num_shards):
+                fleet = []
+                for i in range(self.num_replicas):
+                    takeover = i == 0 and not self.standby
+                    fleet.append(self._spawn(k, replica_index=i,
+                                             primary=self.endpoints[k],
+                                             takeover=takeover))
+                self._replicas.append(fleet)
+            self.replica_endpoints = [
+                [self._await_file(f"replica{k}.{i}.endpoint", k, deadline,
+                                  proc=self._replicas[k][i])
+                 for i in range(self.num_replicas)]
+                for k in range(self.num_shards)]
         manifest = {"version": LAYOUT_VERSION,
                     "num_shards": self.num_shards,
                     "endpoints": self.endpoints,
+                    "replicas": self.replica_endpoints,
                     "tables": self.entries}
         tmp = self.layout_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -103,16 +135,23 @@ class ShardGroup:
                                 primary=self.endpoints[k]))
             for k in range(self.num_shards):
                 self._await_file(f"standby{k}.ready", k, deadline)
-        log.info("shard group up: %d shard(s) at %s%s", self.num_shards,
-                 self.endpoints, " (+warm standbys)" if self.standby else "")
+        log.info("shard group up: %d shard(s) at %s%s%s", self.num_shards,
+                 self.endpoints, " (+warm standbys)" if self.standby else "",
+                 (f" (+{self.num_replicas} read replica(s)/shard)"
+                  if self.num_replicas else ""))
         return self
 
     def _spawn(self, shard: int, standby: bool = False,
-               primary: str = "") -> subprocess.Popen:
+               primary: str = "", replica_index: Optional[int] = None,
+               takeover: bool = False) -> subprocess.Popen:
         argv = [sys.executable, "-m", "multiverso_tpu.shard._child",
                 "--spec", self.spec_path, "--shard", str(shard)]
         if standby:
             argv += ["--standby", "--primary", primary]
+        elif replica_index is not None:
+            argv += ["--replica", str(replica_index), "--primary", primary]
+            if takeover:
+                argv += ["--takeover"]
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -120,37 +159,46 @@ class ShardGroup:
         # a local group multiplexes one host: the children run CPU tables
         # (production shards get one accelerator-owning host each)
         env.setdefault("JAX_PLATFORMS", "cpu")
-        role = "standby" if standby else "shard"
-        logf = open(os.path.join(self.base_dir, f"{role}{shard}.log"), "ab")
+        role = ("standby" if standby
+                else f"replica{shard}.{replica_index}"
+                if replica_index is not None else "shard")
+        name = role if replica_index is not None else f"{role}{shard}"
+        logf = open(os.path.join(self.base_dir, f"{name}.log"), "ab")
         try:
             return subprocess.Popen(argv, stdout=logf, stderr=logf, env=env)
         finally:
             logf.close()  # the child holds its own fd
 
-    def _await_file(self, name: str, shard: int, deadline: float) -> str:
+    def _await_file(self, name: str, shard: int, deadline: float,
+                    proc: Optional[subprocess.Popen] = None) -> str:
         path = os.path.join(self.base_dir, name)
-        procs = self._standbys if name.startswith("standby") else \
-            self._primaries
+        if proc is None:
+            procs = self._standbys if name.startswith("standby") else \
+                self._primaries
+            proc = procs[shard] if shard < len(procs) else None
         while time.monotonic() < deadline:
             if os.path.exists(path):
                 with open(path, "r", encoding="utf-8") as f:
                     content = f.read().strip()
                 if content:
                     return content
-            proc = procs[shard] if shard < len(procs) else None
             if proc is not None and proc.poll() is not None:
                 log.fatal("shard child %d died during startup (rc=%s); "
                           "see %s", shard, proc.returncode,
                           os.path.join(self.base_dir,
-                                       name.split(".")[0] + ".log"))
+                                       name.split(".endpoint")[0].split(
+                                           ".ready")[0] + ".log"))
             time.sleep(0.05)
         log.fatal("shard group startup timed out waiting for %s", name)
 
-    def connect(self, timeout: float = 30.0) -> ShardedClient:
-        """A router client over this group's layout."""
+    def connect(self, timeout: float = 30.0,
+                read_preference: Optional[str] = None) -> ShardedClient:
+        """A router client over this group's layout. ``read_preference``
+        overrides the flag for this client (primary|replica|hedged)."""
         if self.layout is None:
             log.fatal("ShardGroup.connect before start()")
-        return ShardedClient(self.layout, timeout=timeout)
+        return ShardedClient(self.layout, timeout=timeout,
+                             read_preference=read_preference)
 
     # -- chaos / failover hooks ----------------------------------------------
     def kill_shard(self, shard: int) -> None:
@@ -162,20 +210,32 @@ class ShardGroup:
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=30)
 
+    def kill_replica(self, shard: int, index: int = 0) -> None:
+        """SIGKILL one of shard ``shard``'s read replicas — the read-path
+        chaos hook: clients' reads transparently fail over to the
+        remaining replicas / the primary (zero caller-visible errors, the
+        drill tests/test_replica.py pins)."""
+        proc = self._replicas[shard][index]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
     def wait_failover(self, shard: int, timeout: float = 60.0) -> str:
         """Block until shard ``shard``'s standby has taken over; returns
         the (re-bound) service endpoint."""
         deadline = time.monotonic() + timeout
         return self._await_file(f"standby{shard}.tookover", shard, deadline)
 
+    def _all_procs(self) -> List[subprocess.Popen]:
+        return (list(self._primaries)
+                + [p for p in self._standbys if p is not None]
+                + [p for fleet in self._replicas for p in fleet])
+
     def stop(self) -> None:
-        for proc in list(self._primaries) + [p for p in self._standbys
-                                             if p is not None]:
+        for proc in self._all_procs():
             if proc.poll() is None:
                 proc.terminate()
         deadline = time.monotonic() + 15.0
-        for proc in list(self._primaries) + [p for p in self._standbys
-                                             if p is not None]:
+        for proc in self._all_procs():
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
@@ -183,6 +243,7 @@ class ShardGroup:
                 proc.wait(timeout=10)
         self._primaries.clear()
         self._standbys.clear()
+        self._replicas.clear()
 
     def __enter__(self) -> "ShardGroup":
         return self

@@ -50,6 +50,8 @@ class ShardLayout:
 
         {"version": 1, "num_shards": N,
          "endpoints": ["host:port", ...],           # one per shard
+         "replicas": [["host:port", ...], ...],     # optional: per-shard
+                                                    # read-replica fleets
          "tables": [{"table_id": 0, "kind": "matrix",
                      "params": {...global ctor args...},
                      "partitioner": {"kind": "range", ...}}, ...]}
@@ -66,6 +68,13 @@ class ShardLayout:
         if self.num_shards != len(self.endpoints):
             log.fatal("shard layout lists %d endpoints for %d shards",
                       len(self.endpoints), self.num_shards)
+        # per-shard read-replica endpoints (read-replica tier); absent or
+        # short lists pad to [] — a shard with no replicas simply serves
+        # every Get from its primary
+        raw = list(manifest.get("replicas", []))
+        self.replicas: List[List[str]] = [
+            list(raw[k]) if k < len(raw) else []
+            for k in range(self.num_shards)]
         self.tables: List[Dict[str, Any]] = list(manifest["tables"])
         self._parts: Dict[int, Any] = {}
 
@@ -474,7 +483,8 @@ class ShardedClient:
     keeps — one shard's failover never blocks the others' traffic.
     """
 
-    def __init__(self, layout: Any, timeout: float = 30.0) -> None:
+    def __init__(self, layout: Any, timeout: float = 30.0,
+                 read_preference: Optional[str] = None) -> None:
         self.layout = (layout if isinstance(layout, ShardLayout)
                        else ShardLayout(layout))
         from multiverso_tpu.runtime.remote import RemoteClient
@@ -485,8 +495,15 @@ class ShardedClient:
         self._ef_lock = threading.Lock()
         self._clients: List[RemoteClient] = []
         try:
-            for endpoint in self.layout.endpoints:
-                self._clients.append(RemoteClient(endpoint, timeout=timeout))
+            for shard, endpoint in enumerate(self.layout.endpoints):
+                # each per-shard client owns ITS shard's read tier: the
+                # layout's replica fleet for that shard, routed per the
+                # read preference with per-shard fallback to that
+                # shard's primary (docs/serving.md)
+                self._clients.append(RemoteClient(
+                    endpoint, timeout=timeout,
+                    read_endpoints=self.layout.replicas[shard],
+                    read_preference=read_preference))
         except BaseException:
             self.close()
             raise

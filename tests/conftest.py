@@ -40,7 +40,9 @@ def _apply_env_flag_overrides():
     for env, flag in (("MV_WIRE_COALESCE_FRAMES", "wire_coalesce_frames"),
                       ("MV_WIRE_COALESCE_BYTES", "wire_coalesce_bytes"),
                       ("MV_WIRE_SHM", "wire_shm"),
-                      ("MV_APPLY_BATCH_MSGS", "apply_batch_msgs")):
+                      ("MV_APPLY_BATCH_MSGS", "apply_batch_msgs"),
+                      ("MV_READ_PREFERENCE", "read_preference"),
+                      ("MV_CLIENT_CACHE_BYTES", "client_cache_bytes")):
         raw = os.environ.get(env)
         if raw:
             mv.set_flag(flag, raw)

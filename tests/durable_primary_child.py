@@ -105,6 +105,7 @@ def main() -> int:
     opts = sys.argv[3:]
     crash_point, crash_at = None, 0
     batch_hold = 0
+    fault_spec, fault_seed = "", 0
     for arg in opts:
         if arg.startswith("--crash-point="):
             crash_point = arg.split("=", 1)[1]
@@ -112,12 +113,19 @@ def main() -> int:
             crash_at = int(arg.split("=", 1)[1])
         elif arg.startswith("--batch-hold="):
             batch_hold = int(arg.split("=", 1)[1])
+        elif arg.startswith("--fault-spec="):
+            # chaos on THIS server's transports (replication stream
+            # included) — the replica gap-resync drills use it
+            fault_spec = arg.split("=", 1)[1]
+        elif arg.startswith("--fault-seed="):
+            fault_seed = int(arg.split("=", 1)[1])
     if batch_hold > 0:
         # BEFORE mv.init: the dispatcher thread blocks inside pop_all from
         # startup, so patching later would miss its first (held) drain
         _arm_batch_hold(batch_hold)
     flags = dict(ps_role="server", remote_workers=2, wal_dir=wal_dir,
-                 heartbeat_seconds=0.2, lease_seconds=30.0)
+                 heartbeat_seconds=0.2, lease_seconds=30.0,
+                 fault_spec=fault_spec, fault_seed=fault_seed)
     if "--sync" in opts:
         flags["sync"] = True
     mv.init(**flags)
