@@ -46,6 +46,7 @@ test: native
 chaos:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
 		tests/test_fault.py tests/test_durable.py tests/test_obs.py \
+		tests/test_obs_plane.py \
 		tests/test_shm.py tests/test_apply_batch.py \
 		tests/test_replica.py -q \
 		-k "not crash_point and not failover" \

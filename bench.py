@@ -1356,6 +1356,96 @@ def _parse_shards_arg(argv):
     return None
 
 
+# -- regression compare (bench.py --compare A.json B.json) --------------------
+# CI runs this non-blocking against the previous round's BENCH_r*.json so a
+# perf regression is VISIBLE in the log even when environment noise makes it
+# non-fatal; operators run it blocking before accepting a perf-sensitive PR.
+
+# direction classification by key shape: latencies regress UP,
+# throughputs/ratios regress DOWN; everything else (configs, counts,
+# notes, nested sweeps) is not a comparable metric
+_LOWER_BETTER_SUFFIXES = ("_us", "_ms", "_seconds")
+_HIGHER_BETTER_MARKS = ("per_sec", "_gbps", "_x", "hit_rate",
+                        "vs_target")
+
+
+def _bench_metric_direction(key):
+    """'down' (lower is better), 'up' (higher is better), or None
+    (not a comparable metric)."""
+    if key.endswith(_LOWER_BETTER_SUFFIXES) or key.endswith(
+            "overhead_pct"):
+        return "down"
+    if any(mark in key for mark in _HIGHER_BETTER_MARKS):
+        return "up"
+    return None
+
+
+def _load_bench_json(path):
+    """A bench result file: either the raw one-line JSON ``main()``
+    prints, or a BENCH_r*.json round wrapper (result under 'parsed')."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    return {k: float(v) for k, v in data.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def bench_compare(path_a, path_b, threshold=0.10):
+    """Compare two bench result files (A = baseline, B = candidate):
+    any throughput down or latency up by more than ``threshold``
+    (fractional) is a regression. Prints a verdict table; returns the
+    list of regressed metric names (empty = pass)."""
+    a, b = _load_bench_json(path_a), _load_bench_json(path_b)
+    rows, regressions = [], []
+    for key in sorted(set(a) & set(b)):
+        direction = _bench_metric_direction(key)
+        if direction is None or a[key] == 0:
+            continue
+        change = (b[key] - a[key]) / abs(a[key])
+        if direction == "down":
+            regressed = change > threshold
+            improved = change < -threshold
+        else:
+            regressed = change < -threshold
+            improved = change > threshold
+        verdict = ("REGRESSED" if regressed
+                   else "improved" if improved else "ok")
+        if regressed:
+            regressions.append(key)
+        rows.append((key, a[key], b[key], change * 100.0, verdict))
+    print(f"bench compare: A={path_a}  B={path_b}  "
+          f"threshold={threshold * 100:.0f}%")
+    print(f"{'metric':<36} {'A':>14} {'B':>14} {'delta':>8}  verdict")
+    for key, va, vb, pct, verdict in rows:
+        print(f"{key:<36} {va:>14.4g} {vb:>14.4g} {pct:>+7.1f}%  "
+              f"{verdict}")
+    if regressions:
+        print(f"REGRESSIONS ({len(regressions)}): "
+              + ", ".join(regressions))
+    else:
+        print("no regressions beyond threshold")
+    return regressions
+
+
+def _run_compare(argv):
+    """``--compare A.json B.json [--threshold 0.1]`` -> exit status."""
+    import sys
+    i = argv.index("--compare")
+    paths = [a for a in argv[i + 1:] if not a.startswith("--")][:2]
+    if len(paths) != 2:
+        print("usage: bench.py --compare A.json B.json "
+              "[--threshold 0.1]", file=sys.stderr)
+        return 2
+    threshold = 0.10
+    for j, arg in enumerate(argv):
+        if arg == "--threshold" and j + 1 < len(argv):
+            threshold = float(argv[j + 1])
+        elif arg.startswith("--threshold="):
+            threshold = float(arg.split("=", 1)[1])
+    return 1 if bench_compare(paths[0], paths[1], threshold) else 0
+
+
 if __name__ == "__main__":
     import sys
     # spawn_lockstep_world child argv: rank world coord ctl scenario
@@ -1374,6 +1464,9 @@ if __name__ == "__main__":
         # primary vs replica vs replica+cache vs hedged
         print(json.dumps({"metric": "read_gets_per_sec_replica_cache",
                           **bench_read()}))
+    elif "--compare" in sys.argv[1:]:
+        # regression diff of two result files (CI runs non-blocking)
+        sys.exit(_run_compare(sys.argv))
     else:
         shards = _parse_shards_arg(sys.argv[1:])
         if shards is not None:

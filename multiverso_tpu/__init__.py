@@ -52,6 +52,7 @@ def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
     _configure_native_allocator()
     _configure_profiling()
     _start_metrics_logger()
+    _start_observability()
     return remaining
 
 
@@ -75,6 +76,40 @@ def _stop_metrics_logger() -> None:
     if _metrics_logger is not None:
         _metrics_logger.close()  # flushes a final snapshot
         _metrics_logger = None
+
+
+_slo_engine = None
+
+
+def _start_observability() -> None:
+    """Start the observability plane's background halves: the
+    time-series sampler (``timeseries_interval_seconds``; <= 0 disables)
+    and — only when ``slo_spec`` declares objectives — the SLO burn-rate
+    engine (obs/slo.py). Idempotent across repeated init()."""
+    global _slo_engine
+    if float(get_flag("timeseries_interval_seconds")) > 0:
+        from multiverso_tpu.obs.timeseries import TIMESERIES
+        TIMESERIES.start()
+    if str(get_flag("slo_spec")).strip() and _slo_engine is None:
+        from multiverso_tpu.obs.slo import SLOEngine
+        _slo_engine = SLOEngine()
+        _slo_engine.start()
+
+
+def _stop_observability() -> None:
+    global _slo_engine
+    from multiverso_tpu.obs.timeseries import TIMESERIES
+    TIMESERIES.stop()
+    if _slo_engine is not None:
+        _slo_engine.stop()
+        _slo_engine = None
+
+
+def slo_engine():
+    """The flag-started SLO engine (None unless ``slo_spec`` was set at
+    init); tests and dashboards may also build their own
+    :class:`~multiverso_tpu.obs.slo.SLOEngine` directly."""
+    return _slo_engine
 
 
 def _configure_profiling() -> None:
@@ -131,6 +166,7 @@ def shutdown(finalize_net: bool = True) -> None:
     Zoo.instance().stop(finalize_net)
     _stop_profiling()
     _stop_metrics_logger()
+    _stop_observability()
 
 
 def barrier() -> None:
@@ -234,6 +270,10 @@ def serve(endpoint: str = "127.0.0.1:0") -> str:
     zoo = Zoo.instance()
     if not zoo.started or zoo.server is None:
         log.fatal("serve: init() the PS runtime first (not available in ma mode)")
+    if not str(get_flag("metrics_role")):
+        # fleet identity for labeled Prometheus exposition; replicas and
+        # standbys stamp their own role when they start serving
+        set_flag("metrics_role", "primary")
     if zoo.remote_server is None:
         wal_dir = str(get_flag("wal_dir"))
         if wal_dir and zoo.server.wal is None:
@@ -342,7 +382,7 @@ def shard_connect(endpoints: Any = None, timeout: float = 30.0):
               "; ".join(errors))
 
 
-def stats_all(endpoints: Any, timeout: float = 10.0,
+def stats_all(endpoints: Any, timeout: Optional[float] = None,
               replicas: Optional[Sequence[Sequence[str]]] = None):
     """Fan ``mv.stats`` across a shard group and merge: counters summed,
     histograms merged by bucket addition (quantiles compute on the union
@@ -353,22 +393,107 @@ def stats_all(endpoints: Any, timeout: float = 10.0,
     endpoint list per shard — adds per-replica sub-views on
     ``.replicas`` (a dict ``endpoint -> StatsSnapshot``), merged into
     the totals alongside the primaries (replica replay-lag gauges
-    REPLICA_WATERMARK / REPLICA_LAG_RECORDS live there)."""
+    REPLICA_WATERMARK / REPLICA_LAG_RECORDS live there).
+
+    Probes run CONCURRENTLY with a per-endpoint timeout (default: the
+    ``stats_timeout_seconds`` flag) and the merge is PARTIAL: members
+    that do not answer are listed on the result's ``.unreachable``
+    instead of failing the whole fan-out — one dead replica must not
+    blind the operator to the rest of the fleet. Raises only when NO
+    member answered."""
+    import threading as _threading
     from multiverso_tpu.obs.metrics import merge_stats
     from multiverso_tpu.shard.partition import parse_shard_endpoints
+    if timeout is None:
+        timeout = float(get_flag("stats_timeout_seconds"))
     if replicas is None:
         replicas = getattr(endpoints, "replica_endpoints", None)
     endpoints = getattr(endpoints, "endpoints", endpoints)
-    snaps = [stats(e, timeout=timeout)
-             for e in parse_shard_endpoints(endpoints)]
-    replica_snaps = {}
-    for fleet in (replicas or []):
-        for endpoint in fleet:
-            replica_snaps[endpoint] = stats(endpoint, timeout=timeout)
+    primary_eps = list(parse_shard_endpoints(endpoints))
+    replica_eps = [str(e) for fleet in (replicas or []) for e in fleet]
+    results: dict = {}
+    lock = _threading.Lock()
+
+    def probe(ep: str) -> None:
+        try:
+            snap = stats(ep, timeout=timeout)
+        except (OSError, RuntimeError):
+            snap = None
+        with lock:
+            results[ep] = snap
+
+    all_eps = primary_eps + [e for e in replica_eps
+                             if e not in primary_eps]
+    threads = [_threading.Thread(target=probe, args=(ep,), daemon=True,
+                                 name="mv-stats-probe")
+               for ep in all_eps]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 1.0)
+    snaps = [results[e] for e in primary_eps
+             if results.get(e) is not None]
+    replica_snaps = {e: results[e] for e in replica_eps
+                     if results.get(e) is not None}
+    unreachable = [e for e in all_eps if results.get(e) is None]
+    if not snaps and not replica_snaps:
+        raise ConnectionError(
+            f"stats_all: no endpoint answered within {timeout:.1f}s "
+            f"({', '.join(all_eps)})")
     merged = merge_stats(snaps + list(replica_snaps.values()))
     merged.shards = snaps  # primaries only; replicas get their own view
     merged.replicas = replica_snaps
+    merged.unreachable = unreachable
     return merged
+
+
+def traces(endpoints: Any, timeout: Optional[float] = None,
+           req_id: Optional[int] = None):
+    """Pull and stitch cross-process traces: one slot-free
+    ``Control_Traces`` probe per endpoint plus this process's own trace
+    store, clock-corrected and merged into causally-ordered
+    :class:`~multiverso_tpu.obs.collector.StitchedTrace` spans
+    (docs/observability.md). ``endpoints``: a list of host:port, a
+    :class:`~multiverso_tpu.shard.group.ShardGroup`, or a
+    :class:`~multiverso_tpu.shard.router.ShardedClient` layout —
+    replica fleets are included automatically. Returns the stitched
+    spans (all, or just ``req_id``'s), oldest first."""
+    from multiverso_tpu.obs.collector import TraceCollector
+    eps = _fleet_endpoints(endpoints)
+    collector = TraceCollector(eps, timeout=timeout)
+    collector.collect()
+    return collector.stitch(req_id)
+
+
+def top(endpoints: Any, timeout: Optional[float] = None,
+        format: str = "text") -> str:
+    """The live fleet view (``mv.top``): one stats+watermark probe per
+    serving endpoint, rendered as a terminal table (or ``format="html"``
+    for a browser tab) of per-shard/per-replica roles, watermarks, lag,
+    served request counts, Get p99 and burn-alert state, plus the local
+    SLO engine's panel when one is running (obs/slo.py)."""
+    from multiverso_tpu.obs.slo import fleet_top
+    return fleet_top(_fleet_endpoints(endpoints), engine=_slo_engine,
+                     timeout=timeout, format=format)
+
+
+def _fleet_endpoints(endpoints: Any) -> list:
+    """Flatten a fleet handle — ShardGroup, layout manifest dict, list,
+    or comma-string — into the full serving-endpoint list (primaries
+    first, then replica fleets), deduplicated in order."""
+    from multiverso_tpu.shard.partition import parse_shard_endpoints
+    replicas = getattr(endpoints, "replica_endpoints", None)
+    if isinstance(endpoints, dict):  # a layout manifest
+        replicas = list((endpoints.get("replicas") or {}).values())
+        endpoints = endpoints.get("endpoints", [])
+    eps = list(parse_shard_endpoints(
+        getattr(endpoints, "endpoints", endpoints)))
+    for fleet in (replicas or []):
+        eps.extend(str(e) for e in fleet)
+    seen: dict = {}
+    for e in eps:
+        seen.setdefault(e)
+    return list(seen)
 
 
 def stop_serving() -> None:

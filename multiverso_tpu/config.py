@@ -293,6 +293,52 @@ define_string("flight_recorder_path", "",
 define_int("flight_recorder_traces", 256,
            "how many recent request traces each flight-recorder dump "
            "includes (the in-memory trace ring holds at least this many)")
+# Fleet observability plane (obs/collector.py, obs/timeseries.py,
+# obs/slo.py; docs/observability.md): cross-process trace stitching,
+# windowed time-series, SLO burn-rate alerts.
+define_bool("trace_requests", True,
+            "stamp the v4 header's trace flag on every correlated "
+            "request, so forwarded/derived frames (router parts, read "
+            "confirms, multihost forwards) keep recording under the "
+            "originating req_id; hop recording itself is always on for "
+            "nonzero req_ids — this flag only controls propagation")
+define_bool("trace_read_confirm", True,
+            "a traced replica-served Get additionally fires a slot-free "
+            "Control_Watermark frame at the primary stamped with the "
+            "SAME req_id — the trace then spans client, replica AND the "
+            "primary watermark path, and the client's cache horizon "
+            "advances off the authoritative append watermark")
+define_int("trace_export_max", 256,
+           "how many recent traces a Control_Traces reply ships (each "
+           "process's trace ring holds 512)")
+define_double("timeseries_interval_seconds", 1.0,
+              "seconds between time-series recorder samples of every "
+              "registered counter/gauge/histogram; 0 disables the "
+              "sampler thread (manual sample_now() still works)")
+define_int("timeseries_samples", 600,
+           "ring-buffer length per metric in the time-series recorder "
+           "(retention = this many * timeseries_interval_seconds)")
+define_string("slo_spec", "",
+              "declarative SLOs, ';'-separated: "
+              "name:histogram=H,p=0.99,target=SEC[,windows=SHORT/LONG] | "
+              "name:counter=C,target=PER_SEC[,windows=...] | "
+              "name:gauge=G,target=VALUE. A firing burn alert increments "
+              "SLO_BURN_ALERTS and triggers a tagged flight-recorder "
+              "dump. Empty disables the engine")
+define_double("slo_check_interval_seconds", 5.0,
+              "seconds between SLO engine evaluations; 0 disables the "
+              "engine thread (manual evaluate_now() still works)")
+define_double("stats_timeout_seconds", 5.0,
+              "per-endpoint timeout for the mv.stats_all fan-out: a dead "
+              "or wedged endpoint lands on the merged snapshot's "
+              "unreachable list instead of stalling the whole probe")
+define_int("metrics_shard", -1,
+           "this process's shard index for Prometheus labels "
+           "(mvtpu_*{shard=...}); -1 omits the label")
+define_string("metrics_role", "",
+              "this process's serving role for Prometheus labels "
+              "(primary|replica|standby); empty omits the label. serve() "
+              "and replica/standby startup set it when unset")
 # Sharded serving tier (multiverso_tpu/shard/): table partitioning,
 # client-side router, shard groups with per-shard failover
 # (docs/sharding.md).

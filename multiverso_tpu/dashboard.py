@@ -120,6 +120,14 @@ def _prom_name(name: str, suffix: str = "") -> str:
     return f"mvtpu_{base}{suffix}"
 
 
+def _prom_escape(value: str) -> str:
+    """Label-value escaping per the Prometheus text exposition format:
+    backslash, double-quote and newline."""
+    return (value.replace("\\", "\\\\")
+                 .replace('"', '\\"')
+                 .replace("\n", "\\n"))
+
+
 class Dashboard:
     """Global registry of monitors (reference: ``Dashboard::Watch/Display``)
     plus the telemetry units: counters, histograms, gauges."""
@@ -257,26 +265,64 @@ class Dashboard:
         return "\n".join(lines)
 
     @classmethod
+    def identity(cls) -> Dict[str, str]:
+        """This process's fleet identity as Prometheus labels, from the
+        ``metrics_shard`` / ``metrics_role`` flags (set by ``mv.serve``,
+        shard-group children and replicas at startup). Empty when
+        neither is set — single-process dashboards stay label-free."""
+        from multiverso_tpu import config
+        labels: Dict[str, str] = {}
+        try:
+            shard = int(config.get_flag("metrics_shard"))
+            role = str(config.get_flag("metrics_role"))
+        except Exception:  # noqa: BLE001 — render before flag definition
+            return labels
+        if shard >= 0:
+            labels["shard"] = str(shard)
+        if role:
+            labels["role"] = role
+        return labels
+
+    @classmethod
+    def set_identity(cls, shard: Optional[int] = None,
+                     role: Optional[str] = None) -> None:
+        """Stamp the process's fleet identity (flag-backed, so a
+        dashboard reset does not lose it)."""
+        from multiverso_tpu import config
+        if shard is not None:
+            config.set_flag("metrics_shard", int(shard))
+        if role is not None:
+            config.set_flag("metrics_role", str(role))
+
+    @classmethod
     def _render_prom(cls) -> str:
         with cls._lock:
             monitors = list(cls._monitors.values())
             counters = list(cls._counters.values())
             histograms = list(cls._histograms.values())
             gauges = list(cls._gauges.values())
+        inner = ",".join(f'{k}="{_prom_escape(v)}"'
+                         for k, v in sorted(cls.identity().items()))
+        lab = f"{{{inner}}}" if inner else ""
+
+        def bucket_lab(le: str) -> str:
+            parts = ([inner] if inner else []) + [f'le="{le}"']
+            return "{" + ",".join(parts) + "}"
+
         lines = []
         for c in counters:
             n = _prom_name(c.name)
             lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n}_total {c.value}")
+            lines.append(f"{n}_total{lab} {c.value}")
         for g in gauges:
             n = _prom_name(g.name)
             lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {g.value:g}")
+            lines.append(f"{n}{lab} {g.value:g}")
         for m in monitors:
             n = _prom_name(m.name)
             lines.append(f"# TYPE {n}_seconds summary")
-            lines.append(f"{n}_seconds_sum {m.elapse_ms / 1e3:.9g}")
-            lines.append(f"{n}_seconds_count {m.count}")
+            lines.append(f"{n}_seconds_sum{lab} {m.elapse_ms / 1e3:.9g}")
+            lines.append(f"{n}_seconds_count{lab} {m.count}")
         for h in histograms:
             n = _prom_name(h.name)
             data = h.to_dict()
@@ -284,10 +330,11 @@ class Dashboard:
             cum = 0
             for bound, bucket in zip(data["bounds"], data["buckets"]):
                 cum += bucket
-                lines.append(f'{n}_bucket{{le="{bound:.9g}"}} {cum}')
-            lines.append(f'{n}_bucket{{le="+Inf"}} {data["count"]}')
-            lines.append(f"{n}_sum {data['sum']:.9g}")
-            lines.append(f"{n}_count {data['count']}")
+                lines.append(f'{n}_bucket{bucket_lab(f"{bound:.9g}")} '
+                             f'{cum}')
+            lines.append(f'{n}_bucket{bucket_lab("+Inf")} {data["count"]}')
+            lines.append(f"{n}_sum{lab} {data['sum']:.9g}")
+            lines.append(f"{n}_count{lab} {data['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     @classmethod

@@ -487,11 +487,12 @@ class WarmStandby:
 class ReplicaReadServer:
     """The replica's slot-free read listener (docs/serving.md).
 
-    Answers exactly four frame types — ``Request_Read`` (a watermark-
+    Answers exactly five frame types — ``Request_Read`` (a watermark-
     stamped Get, admission-checked against the request's staleness
-    budget), ``Control_Watermark``, ``Control_Stats`` and heartbeats —
-    and refuses everything else loudly: a replica is not a write target,
-    and a misdirected Add must fail visibly rather than fork state.
+    budget), ``Control_Watermark``, ``Control_Stats``,
+    ``Control_Traces`` and heartbeats — and refuses everything else
+    loudly: a replica is not a write target, and a misdirected Add must
+    fail visibly rather than fork state.
     Reads run through the standby's dispatcher-serialized seam, so they
     interleave cleanly with the replay applies and the watermark each
     reply carries is exact for the state it observed."""
@@ -503,6 +504,10 @@ class ReplicaReadServer:
         self._standby = standby
         self._net = make_net()
         self.endpoint = self._net.bind(0, endpoint)
+        if not str(config.get_flag("metrics_role")):
+            # serving reads makes this process a replica in the fleet's
+            # labeled metrics (unless a launcher already stamped a role)
+            config.set_flag("metrics_role", "replica")
         self._compress = bool(config.get_flag("wire_compression"))
         hb = float(config.get_flag("heartbeat_seconds"))
         # freshness window: with heartbeats on, a replica that has heard
@@ -546,6 +551,16 @@ class ReplicaReadServer:
                 src=0, dst=msg.src, type=MsgType.Control_Reply_Stats,
                 msg_id=msg.msg_id, req_id=msg.req_id,
                 data=wire.encode(Dashboard.snapshot())))
+        elif msg.type == MsgType.Control_Traces:
+            from multiverso_tpu.obs.trace import TRACES
+            n = max(1, int(config.get_flag("trace_export_max")))
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Control_Reply_Traces,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode({"role": "replica",
+                                  "endpoint": self.endpoint or "",
+                                  "t_reply_ns": time.time_ns(),
+                                  "traces": TRACES.export(n)})))
         else:
             self._reply_error(msg, f"replica serves reads only (got "
                                    f"{msg.type.name}); writes go to the "
@@ -594,10 +609,11 @@ class ReplicaReadServer:
 
         result, watermark = self._standby._run(run)
         count("READS_SERVED_REPLICA")
+        hop(msg.req_id, "replica_read_reply_sent")
         self._net.send_via(msg._conn, Message(
             src=0, dst=msg.src, type=MsgType.Reply_Read,
             table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
-            watermark=int(watermark),
+            trace=msg.trace, watermark=int(watermark),
             data=wire.encode(result, compress=self._compress)))
 
     def _reply_watermark(self, msg: Message) -> None:

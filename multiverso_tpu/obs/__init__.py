@@ -9,13 +9,26 @@ What joins the PR-1/2 Counters and the reference-era Monitors
   traces and the :class:`FlightRecorder` (dump-on-anomaly JSONL).
 * :mod:`~multiverso_tpu.obs.logger` — :class:`MetricsLogger` periodic
   JSONL snapshots (``metrics_path`` / ``metrics_interval_seconds``).
+* :mod:`~multiverso_tpu.obs.collector` — :class:`TraceCollector`
+  cross-process trace stitching over the ``Control_Traces`` RPC
+  (clock-offset estimation + causally-ordered merged spans).
+* :mod:`~multiverso_tpu.obs.timeseries` — :class:`TimeSeriesRecorder`
+  ring-buffer sampling of the registry (windowed rates / quantiles).
+* :mod:`~multiverso_tpu.obs.slo` — declarative SLOs with multi-window
+  burn-rate alerting, and the ``mv.top`` fleet view.
 
 Operator treatment: ``docs/observability.md`` (metric catalog, trace
 stage list, flight-recorder format, stats RPC usage).
 """
 
 from multiverso_tpu.obs.metrics import (  # noqa: F401
-    Gauge, Histogram, StatsSnapshot, log_bounds)
+    Gauge, Histogram, StatsSnapshot, log_bounds, merge_stats)
 from multiverso_tpu.obs.trace import (  # noqa: F401
     RECORDER, TRACES, FlightRecorder, TraceStore, flight_dump, hop)
 from multiverso_tpu.obs.logger import MetricsLogger, load_metrics  # noqa: F401
+from multiverso_tpu.obs.collector import (  # noqa: F401
+    StitchedTrace, TraceCollector, collect_traces, estimate_offset)
+from multiverso_tpu.obs.timeseries import (  # noqa: F401
+    TIMESERIES, TimeSeriesRecorder)
+from multiverso_tpu.obs.slo import (  # noqa: F401
+    Objective, SLOEngine, default_objectives, fleet_top, parse_slo_spec)

@@ -204,6 +204,10 @@ class StatsSnapshot:
         # group runs serving read replicas; the replica replay-lag gauges
         # (REPLICA_WATERMARK / REPLICA_LAG_RECORDS) live in these
         self.replicas: Dict[str, "StatsSnapshot"] = {}
+        # endpoints that did not answer within the per-endpoint timeout
+        # when this is a merged partial view (mv.stats_all): the merge is
+        # over the REACHABLE members only, and this says which are not
+        self.unreachable: List[str] = []
 
     def histogram(self, name: str) -> Optional[Histogram]:
         return self._histograms.get(name)

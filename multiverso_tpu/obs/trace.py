@@ -29,12 +29,26 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MAX_HOPS_PER_TRACE = 64
 
+# Loss counters at the store's bounds, cached Counter objects so the hot
+# path stays one dict hit (Dashboard import is deferred: dashboard.py
+# imports config which must not cycle back through obs at import time).
+_loss_counters: List[Any] = []
+
+
+def _bound_counters():
+    if not _loss_counters:
+        from multiverso_tpu.dashboard import Dashboard
+        _loss_counters.append(Dashboard.counter("TRACE_EVICTED"))
+        _loss_counters.append(Dashboard.counter("TRACE_DROPPED_HOPS"))
+    return _loss_counters
+
 
 class TraceStore:
     """Bounded req_id -> [(stage, t_ns), ...] map. Oldest-trace eviction
     keeps memory constant under sustained traffic; a trace that outgrows
     ``MAX_HOPS_PER_TRACE`` (a retransmit storm) stops growing rather than
-    leaking."""
+    leaking. Both losses are counted (``TRACE_EVICTED`` /
+    ``TRACE_DROPPED_HOPS``) so a collector knows its view is partial."""
 
     def __init__(self, max_traces: int = 512) -> None:
         self.max_traces = int(max_traces)
@@ -48,14 +62,24 @@ class TraceStore:
             return
         if t_ns is None:
             t_ns = time.time_ns()
+        evicted = dropped = 0
         with self._lock:
             hops = self._traces.get(req_id)
             if hops is None:
                 hops = self._traces[req_id] = []
                 while len(self._traces) > self.max_traces:
                     self._traces.popitem(last=False)
+                    evicted += 1
             if len(hops) < MAX_HOPS_PER_TRACE:
                 hops.append((stage, t_ns))
+            else:
+                dropped = 1
+        if evicted or dropped:
+            ctr_evicted, ctr_dropped = _bound_counters()
+            if evicted:
+                ctr_evicted.add(evicted)
+            if dropped:
+                ctr_dropped.add(dropped)
 
     def get(self, req_id: int) -> List[Tuple[str, int]]:
         with self._lock:
@@ -66,6 +90,12 @@ class TraceStore:
         with self._lock:
             items = list(self._traces.items())
         return [(rid, list(hops)) for rid, hops in items[-n:]]
+
+    def export(self, n: int) -> Dict[int, List[List[Any]]]:
+        """The last ``n`` traces as a JSON/wire-safe dict — the
+        ``Control_Traces`` reply payload a TraceCollector stitches."""
+        return {rid: [[stage, t_ns] for stage, t_ns in hops]
+                for rid, hops in self.recent(n)}
 
     def __len__(self) -> int:
         with self._lock:
@@ -126,9 +156,11 @@ class FlightRecorder:
 
     def _render(self, reason: str, n: int, details: Dict[str, Any]) -> str:
         from multiverso_tpu.dashboard import Dashboard
-        out = [json.dumps({"kind": "event", "reason": reason,
-                           "t_ns": time.time_ns(),
-                           **{k: _jsonable(v) for k, v in details.items()}})]
+        # details go first so a colliding key (e.g. kind=) can never
+        # clobber the line-shape discriminator fields
+        out = [json.dumps({**{k: _jsonable(v) for k, v in details.items()},
+                           "kind": "event", "reason": reason,
+                           "t_ns": time.time_ns()})]
         out.append(json.dumps({"kind": "snapshot",
                                **Dashboard.snapshot()}))
         for req_id, hops in self.store.recent(n):

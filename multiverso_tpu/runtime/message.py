@@ -75,6 +75,13 @@ class MsgType(enum.IntEnum):
     # slot-free like the stats probe
     Control_Watermark = 42
     Control_Reply_Watermark = -42
+    # trace pull RPC (obs/collector.py): any serving process ships the
+    # recent contents of its per-request trace store — req_id -> hops —
+    # plus its wall clock at reply time, so a TraceCollector can estimate
+    # per-process clock offsets and stitch cross-process spans. Slot-free
+    # like the stats/watermark probes.
+    Control_Traces = 43
+    Control_Reply_Traces = -43
 
     @property
     def is_server_bound(self) -> bool:
@@ -118,6 +125,15 @@ class Message:
     # the record's own sequence (gap detection). On a Request_Read: the
     # client's staleness budget in records (-1 = unbounded). -1 elsewhere.
     watermark: int = -1
+    # Trace flag: ride-along bit in the v4 header (the high bit of the
+    # channel byte — no version bump). A traced request asks every hop it
+    # crosses — router, shard primary, replica, standby, multihost
+    # forward — to keep recording under its req_id AND to preserve the
+    # flag on any frame it derives (forwards, confirms). Replies inherit
+    # it via create_reply. Hop recording itself stays keyed on
+    # req_id != 0; the flag's job is propagation and the read tier's
+    # primary watermark-confirm leg.
+    trace: bool = False
     data: List[Any] = field(default_factory=list)
 
     def create_reply(self) -> "Message":
@@ -129,4 +145,5 @@ class Message:
             table_id=self.table_id,
             msg_id=self.msg_id,
             req_id=self.req_id,
+            trace=self.trace,
         )

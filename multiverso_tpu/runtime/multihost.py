@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import gauge_set, monitor
+from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.runtime.net import _tune_socket
 
@@ -513,12 +514,15 @@ class FollowerServer:
             # backpressure instead of unbounded leader-side queueing
             seq = self._runtime.acquire_window()
             self._runtime.register_pending(msg.msg_id, completion, seq)
+        hop(msg.req_id, "follower_forward")
         # follower hop cost (serialize + control-plane enqueue): the
         # same-named histogram gives its distribution via mv.stats/render
         with monitor("FOLLOWER_FORWARD_MSG"):
+            # req_id rides as an optional trailing element — old leaders
+            # reading the 7-tuple shape still parse the prefix
             self._runtime.send_to_leader(
                 ("req", seq, int(msg.type), msg.table_id, msg.src,
-                 msg.msg_id, request))
+                 msg.msg_id, request, msg.req_id))
 
     # replay executor ------------------------------------------------------
     def execute(self, seq: int, op: str, table_id: int, origin: int,
@@ -882,8 +886,13 @@ class MultihostRuntime:
                 return
             kind = obj[0]
             if kind == "req":
-                _, fwd_seq, msg_type, table_id, src, msg_id, request = obj
+                # 8th element (the origin's trace req_id) is optional:
+                # a 7-tuple from an older follower is an untraced forward
+                (_, fwd_seq, msg_type, table_id, src, msg_id,
+                 request) = obj[:7]
+                req_id = obj[7] if len(obj) > 7 else 0
                 msg_type = MsgType(msg_type)
+                hop(req_id, "leader_recv_forward")
                 data: List[Any] = []
                 if msg_type.is_server_bound and msg_type in (
                         MsgType.Request_Add, MsgType.Request_Get):
@@ -904,7 +913,8 @@ class MultihostRuntime:
                     data = [_Forwarded(peer, msg_id, request), completion]
                 self._server.send(Message(
                     src=src, dst=-1, type=msg_type, table_id=table_id,
-                    msg_id=msg_id, data=data))
+                    msg_id=msg_id, req_id=int(req_id),
+                    trace=bool(req_id), data=data))
             elif kind == "barrier_enter":
                 with self._barrier_cv:
                     self._barrier_arrivals += 1

@@ -373,7 +373,12 @@ class TcpNet:
                 crc = zlib.crc32(payload, crc)
                 segments.append(payload)
                 payload_len += blob_bytes
-        segments[0] = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src,
+        # trace flag rides the channel byte's high bit (channels are tiny
+        # small ints) — no header-layout change, v3-framed transports
+        # (shm rings) inherit it for free
+        wire_channel = channel | (0x80 if getattr(msg, "trace", False)
+                                  else 0)
+        segments[0] = _HEADER.pack(_MAGIC, _VERSION, wire_channel, msg.src,
                                    msg.dst, int(msg.type), msg.table_id,
                                    msg.msg_id, msg.req_id, msg.watermark,
                                    len(msg.data), payload_len, crc)
@@ -682,6 +687,10 @@ class TcpNet:
         head = read(_HEADER.size)
         (magic, version, channel, src, dst, mtype, table_id, msg_id,
          req_id, watermark, nblobs, payload_len, crc) = _HEADER.unpack(head)
+        # the channel byte's high bit is the trace flag — mask it off
+        # before routing (the raw channel's == 1 check must still hold)
+        trace = bool(channel & 0x80)
+        channel &= 0x7F
         if magic != _MAGIC:
             log.error("net: bad frame magic %x", magic)
             raise _WireDesync("bad frame magic")
@@ -720,7 +729,8 @@ class TcpNet:
         hop(req_id, "net_recv")
         msg = Message(src=src, dst=dst, type=MsgType(mtype),
                       table_id=table_id, msg_id=msg_id,
-                      req_id=req_id, watermark=watermark, data=blobs)
+                      req_id=req_id, watermark=watermark, trace=trace,
+                      data=blobs)
         msg._wire_channel = channel
         return msg
 

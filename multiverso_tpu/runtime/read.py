@@ -51,6 +51,7 @@ import numpy as np
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_set
 from multiverso_tpu.fault.inject import make_net
+from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
 
@@ -288,16 +289,20 @@ class ReplicaReader:
 
     # -- read path -----------------------------------------------------------
     def read_async(self, table_id: int, request: Any, budget: int,
-                   cb: Callable) -> Optional[int]:
+                   cb: Callable, req_id: int = 0,
+                   trace: bool = False) -> Optional[int]:
         """Fire one read; ``cb(result, watermark, error)`` exactly once
         unless the token is cancelled first. Returns the cancellation
         token (msg_id), or None when the send itself failed (the reader
-        marks itself dead; the router moves on)."""
+        marks itself dead; the router moves on). ``req_id``/``trace``
+        thread the caller's span through the slot-free frame so the
+        replica's hops land under the same trace id."""
         msg_id = next_msg_id()
         with self._lock:
             self._pending[msg_id] = _PendingRead(cb, time.monotonic())
         msg = Message(src=-1, dst=0, type=MsgType.Request_Read,
                       table_id=table_id, msg_id=msg_id,
+                      req_id=int(req_id), trace=bool(trace),
                       watermark=int(budget),
                       data=wire.encode(request, compress=self._compress))
         try:
@@ -425,11 +430,21 @@ class ReadRouter:
     def __init__(self, endpoints: List[str], preference: str,
                  primary_submit: Callable[[int, Any, Any], None],
                  budget: Optional[int] = None,
-                 cache_bytes: Optional[int] = None) -> None:
+                 cache_bytes: Optional[int] = None,
+                 req_id_source: Optional[Callable[[], int]] = None,
+                 watermark_confirm: Optional[Callable[[int], None]] = None
+                 ) -> None:
         self.preference = validate_read_preference(preference)
         self.budget = int(budget if budget is not None
                           else config.get_flag("read_staleness_records"))
         self._primary_submit = primary_submit
+        # Tracing seams (both optional so bare routers stay valid): a
+        # req_id source makes every routed Get a traced span; the
+        # watermark-confirm callback fires after a REPLICA-served success
+        # so the primary records a hop under the same span (the stitched
+        # trace's third process) and re-advertises its append watermark.
+        self._req_id_source = req_id_source
+        self._watermark_confirm = watermark_confirm
         self._readers = [ReplicaReader(e) for e in endpoints]
         self._rr = 0
         self._rr_lock = threading.Lock()
@@ -484,20 +499,26 @@ class ReadRouter:
         return max(0.001, min(p95, self.timeout))
 
     # -- entry point ---------------------------------------------------------
-    def submit_get(self, table_id: int, request: Any, completion) -> None:
+    def submit_get(self, table_id: int, request: Any, completion) -> int:
         """Serve one Get through the read tier. Settles ``completion``
         exactly once — from the cache, a replica, or the primary
-        fallback."""
+        fallback. Returns the span's req_id (0 untraced) so callers a
+        layer up — the shard router — can append their own hops."""
+        req_id = self._req_id_source() if self._req_id_source else 0
+        hop(req_id, "client_read_submit")
         key = (cache_key(table_id, request)
                if self.cache is not None else None)
         if key is not None:
             hit = self.cache.lookup(key, self.budget)
             if hit is not None:
                 count("READ_CACHE_HITS")
+                hop(req_id, "client_read_cache_hit")
                 completion.done(hit)
-                return
+                return req_id
             count("READ_CACHE_MISSES")
-        _ReadAttempt(self, table_id, request, key, completion).start()
+        _ReadAttempt(self, table_id, request, key, completion,
+                     req_id).start()
+        return req_id
 
 
 class _ReadAttempt:
@@ -506,15 +527,17 @@ class _ReadAttempt:
 
     __slots__ = ("_router", "_table_id", "_request", "_key", "_completion",
                  "_lock", "_settled", "_tried", "_inflight", "_hedged",
-                 "_fell_back")
+                 "_fell_back", "_req_id")
 
     def __init__(self, router: ReadRouter, table_id: int, request: Any,
-                 key: Optional[Tuple], completion) -> None:
+                 key: Optional[Tuple], completion,
+                 req_id: int = 0) -> None:
         self._router = router
         self._table_id = table_id
         self._request = request
         self._key = key
         self._completion = completion
+        self._req_id = int(req_id)
         self._lock = threading.Lock()
         self._settled = False
         self._tried: List[ReplicaReader] = []
@@ -539,10 +562,12 @@ class _ReadAttempt:
         if reader is None:
             return False
         self._tried.append(reader)
+        hop(self._req_id, "client_replica_send")
         token = reader.read_async(
             self._table_id, self._request, self._router.budget,
             lambda result, wm, err, reader=reader:
-                self._on_reply(reader, result, wm, err))
+                self._on_reply(reader, result, wm, err),
+            req_id=self._req_id, trace=bool(self._req_id))
         if token is None:
             return self._fire_next()  # send failed; try another
         with self._lock:
@@ -595,6 +620,13 @@ class _ReadAttempt:
             if self._settle(result=result,
                             winner=self._find_pair(reader)):
                 count("READS_VIA_REPLICA")
+                hop(self._req_id, "client_read_reply")
+                confirm = router._watermark_confirm
+                if confirm is not None and self._req_id:
+                    # replica-served span: ask the primary to stamp a
+                    # watermark hop under the same req_id (the stitched
+                    # trace's third process)
+                    confirm(self._req_id)
                 if self._hedged and len(self._tried) > 1 \
                         and reader is self._tried[-1]:
                     count("READ_HEDGE_WINS")
@@ -636,6 +668,10 @@ class _ReadAttempt:
                 return
             self._fell_back = True
         count("READ_PRIMARY_FALLBACKS")
+        # The primary path mints its own req_id (primary_submit's 3-arg
+        # contract predates tracing); this hop marks the span break so a
+        # collector knows the read continued under a fresh id.
+        hop(self._req_id, "client_read_fallback")
 
         class _Settle:
             __slots__ = ("_attempt",)

@@ -425,6 +425,9 @@ class RemoteServer:
         if msg.type == MsgType.Control_Watermark:
             self._reply_watermark(msg)
             return
+        if msg.type == MsgType.Control_Traces:
+            self._reply_traces(msg)
+            return
         if msg.type == MsgType.Request_Read:
             self._serve_read(msg, compress)
             return
@@ -486,13 +489,32 @@ class RemoteServer:
         """Control_Watermark: this process's position in the WAL stream —
         slot-free like the stats probe (an operator asking 'how stale is
         this endpoint' must get an answer even when every slot is
-        taken)."""
+        taken). A traced replica-served Get fires one of these at the
+        primary under its own req_id (the read tier's confirm leg), so
+        the reply-sent hop below is the 'primary watermark path' segment
+        of a stitched cross-process trace."""
         watermark = self.append_watermark()
+        hop(msg.req_id, "watermark_reply_sent")
         self._net.send_via(msg._conn, Message(
             src=0, dst=msg.src, type=MsgType.Control_Reply_Watermark,
             msg_id=msg.msg_id, req_id=msg.req_id, watermark=watermark,
+            trace=msg.trace,
             data=wire.encode({"role": "primary", "watermark": watermark,
                               "primary_watermark": watermark, "lag": 0})))
+
+    def _reply_traces(self, msg: Message) -> None:
+        """Control_Traces: ship this process's recent per-request traces
+        plus its wall clock at reply time — the pull half of fleet trace
+        stitching (obs/collector.py). Slot-free like the stats probe."""
+        from multiverso_tpu.obs.trace import TRACES
+        n = max(1, int(config.get_flag("trace_export_max")))
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Traces,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=wire.encode({"role": "primary",
+                              "endpoint": self.endpoint or "",
+                              "t_reply_ns": time.time_ns(),
+                              "traces": TRACES.export(n)})))
 
     def _reply_stats(self, msg: Message) -> None:
         """Control_Stats: ship this process's full dashboard — monitors,
@@ -712,6 +734,16 @@ def fetch_watermark(endpoint: str, timeout: float = 10.0) -> Dict[str, Any]:
                          timeout=timeout, what="watermark")
 
 
+def fetch_traces(endpoint: str, timeout: float = 10.0) -> Dict[str, Any]:
+    """One-shot trace pull: ``{"role", "endpoint", "t_reply_ns",
+    "traces": {req_id: [[stage, t_ns], ...]}}`` from any serving process
+    (primary or replica), slot-free. Wire keys arrive as strings/ints
+    depending on codec; the collector normalizes."""
+    return control_probe(endpoint, MsgType.Control_Traces,
+                         MsgType.Control_Reply_Traces,
+                         timeout=timeout, what="traces")
+
+
 def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
     """One-shot live stats RPC: the server's dashboard as a
     :class:`StatsSnapshot` (histograms rebuilt from their bucket arrays,
@@ -784,6 +816,7 @@ class RemoteClient:
         self._inflight: Dict[int, _Inflight] = {}
         self._lock = threading.Lock()
         self._compress = bool(config.get_flag("wire_compression"))
+        self._trace = bool(config.get_flag("trace_requests"))
         # 31-bit nonzero session nonce: req_id = (session << 32) | seq
         # stays within the header's signed 64-bit field
         self._session = random.getrandbits(31) | 1
@@ -819,8 +852,14 @@ class RemoteClient:
                 self._send(table_id, MsgType.Request_Get, request,
                            next_msg_id(), completion, direct=True)
 
-            self._read_router = ReadRouter(list(read_endpoints), preference,
-                                           primary_submit)
+            self._read_router = ReadRouter(
+                list(read_endpoints), preference, primary_submit,
+                req_id_source=(self._next_req_id if self._trace else None),
+                watermark_confirm=(
+                    self._confirm_watermark
+                    if self._trace
+                    and bool(config.get_flag("trace_read_confirm"))
+                    else None))
         self._start_maintenance()
 
     # -- lifecycle -----------------------------------------------------------
@@ -841,6 +880,20 @@ class RemoteClient:
 
     def _next_req_id(self) -> int:
         return (self._session << 32) | (next(self._req_seq) & 0xFFFFFFFF)
+
+    def _confirm_watermark(self, req_id: int) -> None:
+        """Read-tier trace confirm: fire one slot-free Control_Watermark
+        at the primary stamped with a replica-served Get's req_id. The
+        reply both extends the trace across the primary (the 'watermark
+        path' leg of a stitched span) and advances the read cache's
+        horizon off the authoritative append watermark. Fire-and-forget:
+        a lost frame just shortens the trace."""
+        try:
+            self._net.send(Message(
+                src=self.worker_id, dst=0, type=MsgType.Control_Watermark,
+                msg_id=next_msg_id(), req_id=req_id, trace=True))
+        except OSError:
+            pass  # diagnostics never trip recovery; the read already won
 
     def _register(self, timeout: float, resume: bool = False) -> None:
         """Register (or resume) this client's worker slot. The request is
@@ -896,12 +949,15 @@ class RemoteClient:
 
     def _send(self, table_id: int, msg_type: MsgType, request: Any,
               msg_id: int, completion: Optional[Completion],
-              direct: bool = False) -> None:
+              direct: bool = False) -> int:
+        """Returns the req_id the request was issued under (0 for
+        fire-and-forget posts) so callers a layer up — the shard router —
+        can append their own hops to the same trace."""
         if self._read_router is not None and not direct:
             if (msg_type == MsgType.Request_Get and completion is not None
                     and self._read_tier_ok(table_id)):
-                self._read_router.submit_get(table_id, request, completion)
-                return
+                return self._read_router.submit_get(table_id, request,
+                                                    completion)
             if msg_type == MsgType.Request_Add:
                 # this client just changed the table: its cached reads of
                 # it are suspect (write-through invalidation)
@@ -913,6 +969,7 @@ class RemoteClient:
                       table_id=table_id, msg_id=msg_id,
                       req_id=self._next_req_id() if completion is not None
                       else 0,
+                      trace=self._trace and completion is not None,
                       data=data)
         with self._lock:
             if completion is not None:
@@ -923,7 +980,7 @@ class RemoteClient:
             if self._recovering:
                 # recovery retransmits the whole inflight set (in req_id
                 # order) once re-registered; sending now would race it
-                return
+                return msg.req_id
         try:
             self._net.send(msg)
         except OSError:
@@ -931,6 +988,7 @@ class RemoteClient:
                 raise  # fire-and-forget posts keep the fail-loud contract
             self._start_recovery()  # the request stays inflight; recovery
             # (or its deadline) settles the completion
+        return msg.req_id
 
     def _pump(self) -> None:
         while True:
@@ -948,6 +1006,13 @@ class RemoteClient:
                 # cache horizon advances (and a regression — a new
                 # primary incarnation — flushes it)
                 self._read_router.observe_primary_watermark(msg.watermark)
+            if msg.type == MsgType.Control_Reply_Watermark:
+                # the read tier's trace confirm coming home: no pending
+                # completion (fire-and-forget), but the hop closes the
+                # client↔primary request/reply pair the clock-offset
+                # estimator needs
+                hop(msg.req_id, "client_watermark_reply")
+                continue
             with self._lock:
                 completion = self._pending.pop(msg.msg_id, None)
                 flight = self._inflight.pop(msg.msg_id, None)

@@ -83,6 +83,12 @@ def main(argv=None) -> int:
 
     flags = dict(spec.get("flags", {}))
     flags["ps_role"] = "server"
+    # fleet identity for labeled metrics (mvtpu_*{shard=,role=}) — the
+    # role the child was launched AS, not what it may fail over into
+    flags.setdefault("metrics_shard", shard)
+    flags.setdefault("metrics_role",
+                     "standby" if args.standby
+                     else "replica" if args.replica >= 0 else "primary")
     if spec.get("wal_root"):
         suffix = ("-standby" if args.standby
                   else f"-replica{args.replica}" if args.replica >= 0

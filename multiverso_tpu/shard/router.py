@@ -34,6 +34,7 @@ import numpy as np
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, observe
+from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import (RangePartitioner,
                                             partitioner_from_spec)
@@ -576,8 +577,12 @@ class ShardedClient:
         count("ROUTER_FANOUT", len(parts))
         mc = _MergeCompletion(completion, len(parts), merge)
         for idx, (shard, sub) in enumerate(parts):
-            self._clients[shard]._send(table_id, msg_type, sub,
-                                       next_msg_id(), mc.part(idx, shard))
+            rid = self._clients[shard]._send(table_id, msg_type, sub,
+                                             next_msg_id(),
+                                             mc.part(idx, shard))
+            # _send returns the per-shard span id (0 untraced): tag which
+            # shard this leg targeted so a stitched trace shows the fan
+            hop(rid, f"router_shard{shard}")
 
     def _post_all(self, table_id: int, msg_type: MsgType) -> None:
         """Fire-and-forget control posts (finish_train) fan to every
