@@ -4,6 +4,8 @@
 # driver measures:
 #
 #   make check          native build + tests + multi-chip dryrun + bench
+#   make lint           mvlint project-invariant static analysis (blocking
+#                       in CI; docs/static_analysis.md)
 #   make native         just the C++ layer (libmultiverso_tpu.so + C client)
 #   make test           just the suite (8-device virtual CPU mesh)
 #   make chaos          fault-injection + durability + telemetry suites,
@@ -30,10 +32,13 @@ PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check chaos failover sharded replicas metrics-smoke native test \
-	dryrun bench apply-bench read-bench clean
+.PHONY: check lint chaos failover sharded replicas metrics-smoke native \
+	test dryrun bench apply-bench read-bench clean
 
-check: native test dryrun bench
+check: lint native test dryrun bench
+
+lint:
+	$(PYTHON) -m tools.mvlint
 
 native:
 	$(MAKE) -C multiverso_tpu/native

@@ -60,6 +60,7 @@ from multiverso_tpu.fault.detector import LivenessDetector
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.runtime import wire
+from multiverso_tpu.runtime.contracts import slot_free
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
 
 _PRIMARY = 0  # the lease id the primary is tracked under
@@ -567,6 +568,7 @@ class ReplicaReadServer:
                                    "primary")
 
     # -- read path -----------------------------------------------------------
+    @slot_free
     def _refusal(self, budget: int) -> Optional[str]:
         """Why this replica may NOT answer a read with staleness budget
         ``budget`` right now (None = admitted). Budget < 0 is unbounded:
@@ -589,6 +591,7 @@ class ReplicaReadServer:
                     "freshness window — lag cannot be bounded")
         return None
 
+    @slot_free
     def _serve_read(self, msg: Message) -> None:
         refusal = self._refusal(int(msg.watermark))
         if refusal is not None:
@@ -616,6 +619,7 @@ class ReplicaReadServer:
             trace=msg.trace, watermark=int(watermark),
             data=wire.encode(result, compress=self._compress)))
 
+    @slot_free
     def _reply_watermark(self, msg: Message) -> None:
         s = self._standby
         self._net.send_via(msg._conn, Message(
@@ -628,6 +632,7 @@ class ReplicaReadServer:
                               "lag": s.lag_records(),
                               "primary_dead": bool(s.primary_dead)})))
 
+    @slot_free
     def _reply_error(self, msg: Message, text: str) -> None:
         try:
             self._net.send_via(msg._conn, Message(

@@ -60,6 +60,7 @@ from multiverso_tpu.checkpoint import (
     _run_serialized, load_table, read_array, write_array)
 from multiverso_tpu.dashboard import count, gauge_set, observe
 from multiverso_tpu.obs.trace import hop
+from multiverso_tpu.runtime.contracts import dispatcher_only
 
 _SEG_MAGIC = b"MVWL"
 _SEG_VERSION = 1
@@ -224,6 +225,7 @@ class WalWriter:
             self._streams[table_id] = stream
         return stream
 
+    @dispatcher_only
     def append(self, req_id: int, worker: int, table_id: int, msg_id: int,
                blobs: List[np.ndarray]) -> int:
         """Append one record; returns its sequence number (the append

@@ -15,6 +15,11 @@ model and cookbook):
   sync watchdog escalates from logging a stall to EVICTING a worker whose
   lease expired, so BSP/SSP rounds no longer deadlock on a crashed peer
   (the condition under which Ho et al.'s SSP gate is safe in production).
+* :mod:`multiverso_tpu.fault.lockcheck` — runtime lock-order sanitizer:
+  under ``MV_LOCKCHECK=1`` the threading lock factories are wrapped to
+  record the per-thread acquisition graph, report lock-order cycles
+  (potential deadlocks) and hold-time outliers, and dump the offending
+  stacks through the flight recorder.
 
 Counters (``CLIENT_RETRIES``, ``CLIENT_RECONNECTS``, ``SERVER_DEDUP_HITS``,
 ``WORKER_EVICTIONS``, ``FAULT_INJECTED_*``) register in the dashboard so
@@ -25,3 +30,4 @@ from multiverso_tpu.fault.detector import LivenessDetector  # noqa: F401
 from multiverso_tpu.fault.inject import (  # noqa: F401
     ChaosNet, FaultInjector, FaultRule, make_net, parse_fault_spec)
 from multiverso_tpu.fault.retry import RetryPolicy  # noqa: F401
+from multiverso_tpu.fault import lockcheck  # noqa: F401

@@ -39,19 +39,29 @@ class MsgType(enum.IntEnum):
     Reply_Add = -2
     Reply_Read = -3
     Reply_Error = -5  # request failed server-side / peer connection lost
-    # control plane (>= 32 request, <= -32 reply)
-    Control_Barrier = 33
-    Control_Reply_Barrier = -33
+    # control plane (>= 32 request, <= -32 reply).  Value 33 (the
+    # reference repo's Control_Barrier) is retired: barriers are
+    # threading.Barrier in-process and multihost.barrier() across hosts,
+    # so the wire type was dead — do not reuse the value.
     Control_Register = 34
     Control_Reply_Register = -34
-    Control_Deregister = 35  # graceful client close frees its worker slot
-    Control_Heartbeat = 36  # remote worker lease renewal (fault/detector.py)
+    # graceful client close frees its worker slot; fire-and-forget by
+    # design — the closing side cannot wait on a reply from a socket it
+    # is tearing down
+    Control_Deregister = 35  # mvlint: ignore[msg-pairs]
+    # remote worker lease renewal (fault/detector.py); fire-and-forget —
+    # a lease beat that needed an ACK would turn the liveness plane into
+    # a second request plane
+    Control_Heartbeat = 36  # mvlint: ignore[msg-pairs]
     # warm-standby replication (durable/standby.py): a standby subscribes
     # with Control_Replicate, receives a quiesced full-state transfer in
     # the reply, then tails the primary's WAL as Control_Wal_Record frames
     Control_Replicate = 37
     Control_Reply_Replicate = -37
-    Control_Wal_Record = 38
+    # one-way replication stream: per-record ACKs would serialize the
+    # primary's apply path on the standby's RTT; loss is detected by seq
+    # gaps at the standby instead
+    Control_Wal_Record = 38  # mvlint: ignore[msg-pairs]
     # live stats RPC (obs/): mv.stats(endpoint) pulls a remote server's
     # full dashboard — monitors, counters, gauges, histograms serialized
     # as bucket arrays — without registering a worker slot

@@ -54,6 +54,7 @@ from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.fault.retry import RetryPolicy
 from multiverso_tpu.obs.metrics import StatsSnapshot
 from multiverso_tpu.obs.trace import flight_dump, hop
+from multiverso_tpu.runtime.contracts import slot_free
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
 from multiverso_tpu.runtime.net import TcpNet
 from multiverso_tpu.runtime import wire
@@ -469,6 +470,7 @@ class RemoteServer:
         hop(msg.req_id, "dispatch_enqueue")
         self._zoo.server.send(forward)
 
+    @slot_free
     def _serve_read(self, msg: Message, compress: bool) -> None:
         """Request_Read on the PRIMARY: a slot-free Get — no worker slot,
         no lease, no dedup entry. The request rides the dispatcher queue
@@ -485,6 +487,7 @@ class RemoteServer:
             table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
             data=[request, completion]))
 
+    @slot_free
     def _reply_watermark(self, msg: Message) -> None:
         """Control_Watermark: this process's position in the WAL stream —
         slot-free like the stats probe (an operator asking 'how stale is
@@ -502,6 +505,7 @@ class RemoteServer:
             data=wire.encode({"role": "primary", "watermark": watermark,
                               "primary_watermark": watermark, "lag": 0})))
 
+    @slot_free
     def _reply_traces(self, msg: Message) -> None:
         """Control_Traces: ship this process's recent per-request traces
         plus its wall clock at reply time — the pull half of fleet trace
@@ -516,6 +520,7 @@ class RemoteServer:
                               "t_reply_ns": time.time_ns(),
                               "traces": TRACES.export(n)})))
 
+    @slot_free
     def _reply_stats(self, msg: Message) -> None:
         """Control_Stats: ship this process's full dashboard — monitors,
         counters, gauges, histograms as bucket arrays — back over the
@@ -527,6 +532,7 @@ class RemoteServer:
             msg_id=msg.msg_id, req_id=msg.req_id,
             data=wire.encode(Dashboard.snapshot())))
 
+    @slot_free
     def _reply_layout(self, msg: Message) -> None:
         """Control_Layout: ship the shard group's layout manifest. Like
         the stats probe: no worker slot, no lease, no dedup entry — a

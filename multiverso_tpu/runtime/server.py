@@ -27,6 +27,7 @@ from typing import Callable, Dict, List, Optional
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_set, monitor, observe
 from multiverso_tpu.obs.trace import flight_dump, hop
+from multiverso_tpu.runtime.contracts import dispatcher_only
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
 
@@ -165,6 +166,7 @@ class Server:
         """Log prefix naming this dispatcher when it is one of many."""
         return f"shard {self.shard_id}: " if self.shard_id >= 0 else ""
 
+    @dispatcher_only
     def _wal_append(self, msg: Message) -> None:
         """Append a wire Add's WAL entry (attached by the RemoteServer)
         immediately before it is applied, so WAL order equals apply order
@@ -301,12 +303,14 @@ class Server:
             self._dispatch_guarded(msg)
         flush()
 
+    @dispatcher_only
     def _apply_add_batch(self, table_id: int, msgs: List[Message]) -> None:
         cap = self._apply_batch_cap
         while msgs:
             consumed = self._apply_add_chunk(table_id, msgs[:cap])
             msgs = msgs[consumed:]
 
+    @dispatcher_only
     def _apply_add_chunk(self, table_id: int, msgs: List[Message]) -> int:
         """Fuse-and-apply a prefix of ``msgs``; returns how many messages
         were handled (the table's merge may consume fewer than offered to
@@ -370,6 +374,7 @@ class Server:
             msg.data[-1].done(None)
         return consumed
 
+    @dispatcher_only
     def _apply_fused(self, table, request) -> None:
         """The fused apply — a named seam so crash-point tests can kill
         the process between a batch's WAL appends and its apply."""
@@ -391,6 +396,7 @@ class Server:
         else:
             log.error("server: unhandled message type %s", msg.type)
 
+    @dispatcher_only
     def _process_add(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_ADD_MSG"):
             request, completion = msg.data
@@ -400,6 +406,7 @@ class Server:
             # add+get sync path); plain adds return None as before
             completion.done(self._tables[msg.table_id].process_add(request))
 
+    @dispatcher_only
     def _process_get(self, msg: Message) -> None:
         with monitor("SERVER_PROCESS_GET_MSG"):
             request, completion = msg.data
@@ -446,6 +453,7 @@ class DeterministicServer(Server):
         self._add_queues[table_id] = [[] for _ in range(self.num_workers)]
         return table_id
 
+    @dispatcher_only
     def _process_add(self, msg: Message) -> None:
         if not 0 <= msg.src < self.num_workers:
             super()._process_add(msg)  # administrative: apply immediately
@@ -460,6 +468,7 @@ class DeterministicServer(Server):
         msg.data[-1].done(None)  # accepted; applies in round order below
         self._drain_adds(msg.table_id)
 
+    @dispatcher_only
     def _drain_adds(self, table_id: int) -> None:
         queues = self._add_queues[table_id]
         while any(queues) and all(
@@ -611,6 +620,7 @@ class SyncServer(Server):
                     time.perf_counter() - gated_at)
         hop(msg.req_id, "gate_released")
 
+    @dispatcher_only
     def _evict_worker(self, worker: int) -> None:
         """Remove a dead worker from every clock gate (dispatcher thread):
         mark it finished so ``_min_adds``/``_min_gets`` stop waiting on its
@@ -670,6 +680,7 @@ class SyncServer(Server):
         on a server-only node, worker id -1) bypasses the clocks."""
         return not 0 <= worker < self.num_workers
 
+    @dispatcher_only
     def _process_add(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
@@ -691,6 +702,7 @@ class SyncServer(Server):
             self._gate_defer(msg)
             self._pending_add[tid].append(msg)
 
+    @dispatcher_only
     def _process_get(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
@@ -716,6 +728,7 @@ class SyncServer(Server):
         for tid in list(self._tables):
             self._drain(tid)
 
+    @dispatcher_only
     def _drain(self, table_id: int) -> None:
         """Release deferred messages whose clock condition now holds."""
         progressed = True
@@ -774,6 +787,7 @@ class SSPServer(SyncServer):
         super().__init__(num_workers)
         self.staleness = int(staleness)
 
+    @dispatcher_only
     def _process_add(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
@@ -797,6 +811,7 @@ class SSPServer(SyncServer):
         (non-backup) worker to have reached."""
         return self._add_clock[tid][worker] - self.staleness
 
+    @dispatcher_only
     def _process_get(self, msg: Message) -> None:
         tid = msg.table_id
         worker = msg.src
@@ -812,6 +827,7 @@ class SSPServer(SyncServer):
             self._gate_defer(msg)
             self._pending_get[tid].append(msg)
 
+    @dispatcher_only
     def _drain(self, table_id: int) -> None:
         still: List[Message] = []
         for msg in self._pending_get[table_id]:

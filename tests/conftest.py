@@ -4,9 +4,25 @@ This replaces the reference's ``mpirun -np N`` harness (SURVEY §4): tier-a
 pure-logic tests need no devices, tier-b "world of 1" tests run the full
 worker→dispatcher→table path in-process, tier-c multi-shard tests run on the
 8-device virtual mesh.
+
+Sanitizer env hooks (``docs/static_analysis.md``):
+
+- ``MV_LOCKCHECK=1`` — wrap the threading lock factories *before* the
+  package imports (fault/lockcheck.py); any test whose run records a
+  lock-order cycle or a hold-time outlier fails with the report, and a
+  session summary lands in ``MV_CHAOS_ARTIFACT_DIR`` when set.
+- ``MV_STRICT=1`` — silent thread death (an uncaught exception in any
+  ``threading.Thread``) fails the test that produced it, and
+  ``ResourceWarning`` (leaked sockets/rings/files) becomes an error.
+- ``faulthandler`` is always on with a watchdog timer: a test wedged
+  past ~2/3 of the suite timeout dumps every thread's stack to stderr,
+  so a CI hang ships the evidence instead of a bare SIGKILL.
 """
 
+import faulthandler
 import os
+import threading
+import warnings
 
 # Must be set before jax initializes its backends. Force CPU even when the
 # ambient environment points at a TPU platform: tests simulate a multi-chip
@@ -23,12 +39,43 @@ import jax  # noqa: E402
 # via config (env alone is not enough once the plugin registered).
 jax.config.update("jax_platforms", "cpu")
 
+MV_LOCKCHECK = os.environ.get("MV_LOCKCHECK", "") == "1"
+MV_STRICT = os.environ.get("MV_STRICT", "") == "1"
+
+if MV_LOCKCHECK:
+    # Patch the lock factories before multiverso_tpu imports so every
+    # lock the package creates (module-level registries included) is
+    # order-checked.
+    from multiverso_tpu.fault import lockcheck
+    lockcheck.enable()
+
 import pytest  # noqa: E402
 
 import multiverso_tpu as mv  # noqa: E402
 from multiverso_tpu.config import FLAGS  # noqa: E402
 from multiverso_tpu.dashboard import Dashboard  # noqa: E402
 from multiverso_tpu.runtime.zoo import Zoo  # noqa: E402
+
+# Dump all thread stacks if the whole run wedges (the per-suite timeout
+# is 870s in ROADMAP's tier-1 command; dump well before the outer
+# timeout -k fires so the evidence beats the SIGKILL).
+faulthandler.enable()
+faulthandler.dump_traceback_later(600, repeat=True, exit=False)
+
+# Record uncaught exceptions from worker threads; a thread dying silently
+# is a bug even when the test's assertions happen to pass.
+_thread_deaths = []
+_orig_excepthook = threading.excepthook
+
+
+def _recording_excepthook(args):
+    _thread_deaths.append("thread %r died: %s: %s" % (
+        args.thread.name if args.thread else "?",
+        getattr(args.exc_type, "__name__", args.exc_type), args.exc_value))
+    _orig_excepthook(args)
+
+
+threading.excepthook = _recording_excepthook
 
 
 def _apply_env_flag_overrides():
@@ -61,6 +108,50 @@ def clean_runtime():
     finally:
         Zoo._reset_instance()
         FLAGS.reset()
+
+
+@pytest.fixture(autouse=True)
+def _sanitizers(request):
+    """Per-test sanitizer verdicts: lockcheck findings and (under
+    MV_STRICT=1) silent thread deaths fail the test that produced them."""
+    deaths_before = len(_thread_deaths)
+    if MV_STRICT:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", ResourceWarning)
+            yield
+    else:
+        yield
+    failures = []
+    if MV_LOCKCHECK:
+        from multiverso_tpu.fault import lockcheck
+        if lockcheck.findings():
+            failures.append("lockcheck:\n" + lockcheck.report_text())
+            lockcheck.take_findings()
+    if MV_STRICT and len(_thread_deaths) > deaths_before:
+        failures.append("silent thread death(s):\n  " +
+                        "\n  ".join(_thread_deaths[deaths_before:]))
+    if failures:
+        pytest.fail("\n\n".join(failures), pytrace=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Ship the lockcheck session summary with the chaos artifacts."""
+    if not MV_LOCKCHECK:
+        return
+    art_dir = os.environ.get("MV_CHAOS_ARTIFACT_DIR")
+    if not art_dir:
+        return
+    from multiverso_tpu.fault import lockcheck
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        path = os.path.join(art_dir, "lockcheck-report.txt")
+        with open(path, "w", encoding="utf-8") as fp:
+            text = lockcheck.report_text()
+            fp.write(text if text else
+                     "lockcheck: no lock-order cycles or hold-time "
+                     "outliers recorded this session\n")
+    except OSError:
+        pass
 
 
 @pytest.fixture

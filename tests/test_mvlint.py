@@ -1,0 +1,420 @@
+"""mvlint + lockcheck: the analysis suite analyzed.
+
+Each rule gets a miniature repo (tmp_path) with a known-bad snippet that
+must trigger, a known-good twin that must pass, and a suppressed variant
+that must stay silent.  The lockcheck units construct a real A→B / B→A
+acquisition cycle across two threads (sequenced so it cannot actually
+deadlock) and assert the cycle report, plus a hold-time outlier under a
+tiny threshold.  Finally the real repo itself must lint clean — the same
+gate ``make lint`` enforces in CI.
+"""
+
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tools.mvlint import run
+from tools.mvlint.core import Project, RULES
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _mini_repo(tmp_path, files, catalog=""):
+    """Build a throwaway repo: {relpath: source} plus a metric catalog."""
+    for rel, body in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(body))
+    doc = tmp_path / "docs" / "observability.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text("# obs\n\n## 1. Metric catalog\n\n" +
+                   textwrap.dedent(catalog) + "\n\n## 2. Other\n")
+    return tmp_path
+
+
+def _findings(tmp_path, rule):
+    return RULES[rule](Project(tmp_path))
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_metrics_docs_flags_undocumented_and_phantom(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/a.py": """
+            from multiverso_tpu.dashboard import count, monitor
+            def f(worker):
+                count("UNDOCUMENTED_TOTAL")
+                with monitor("DOCUMENTED_SECONDS"):
+                    pass
+                count(f"DYNAMIC_W{worker}")
+        """,
+    }, catalog="""
+        `DOCUMENTED_SECONDS` is fine, `DYNAMIC_W<id>` matches the
+        f-string pattern, `PHANTOM_GONE` has no emitter.
+    """)
+    found = _findings(tmp_path, "metrics-docs")
+    messages = [str(f) for f in found]
+    assert any("UNDOCUMENTED_TOTAL" in m for m in messages), messages
+    assert any("PHANTOM_GONE" in m for m in messages), messages
+    assert not any("DOCUMENTED_SECONDS" in m for m in messages), messages
+    assert not any("DYNAMIC" in m for m in messages), messages
+
+
+def test_metrics_docs_suppression_honored(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/a.py": """
+            from multiverso_tpu.dashboard import count
+            def f():
+                count("SCRATCH_ONLY")  # mvlint: ignore[metrics-docs]
+        """,
+    })
+    assert _findings(tmp_path, "metrics-docs") == []
+
+
+# ------------------------------------------------------------------ flags
+
+
+def test_flags_dead_and_undeclared(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/config.py": """
+            def define_int(name, default, help): ...
+            define_int("used_flag", 1, "read below")
+            define_int("dead_flag", 2, "never read")
+        """,
+        "multiverso_tpu/b.py": """
+            from multiverso_tpu.config import get_flag
+            def f():
+                return get_flag("used_flag") + get_flag("ghost_flag")
+        """,
+    })
+    messages = [str(f) for f in _findings(tmp_path, "flags")]
+    assert any("dead_flag" in m and "never read" in m for m in messages)
+    assert any("ghost_flag" in m and "never declared" in m
+               for m in messages)
+    assert not any("used_flag" in m for m in messages)
+
+
+def test_flags_suppression_honored(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/config.py": """
+            def define_int(name, default, help): ...
+            define_int("future_flag", 1, "wip")  # mvlint: ignore[flags]
+        """,
+    })
+    assert _findings(tmp_path, "flags") == []
+
+
+# -------------------------------------------------------------- msg types
+
+
+MSG_ENUM = """
+    from enum import IntEnum
+    class MsgType(IntEnum):
+        Request_Foo = 1
+        Reply_Foo = -1
+        Request_Bar = 2
+        Control_Ping = 33
+        Control_Reply_Ping = -34
+"""
+
+
+def test_msg_pairs_missing_and_mismatched(tmp_path):
+    _mini_repo(tmp_path, {"multiverso_tpu/runtime/message.py": MSG_ENUM})
+    messages = [str(f) for f in _findings(tmp_path, "msg-pairs")]
+    assert any("Request_Bar has no Reply_Bar" in m for m in messages)
+    assert any("Control_Ping = 33 but Control_Reply_Ping = -34" in m
+               for m in messages)
+    assert not any("Request_Foo" in m for m in messages)
+
+
+def test_msg_handlers_dead_member(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/runtime/message.py": MSG_ENUM,
+        "multiverso_tpu/runtime/srv.py": """
+            from multiverso_tpu.runtime.message import MsgType
+            def dispatch(msg):
+                if msg.type == MsgType.Request_Foo:
+                    return "foo"
+                if msg.type in (MsgType.Control_Ping,):
+                    return "ping"
+                # constructing a message is NOT dispatching it
+                return MsgType.Request_Bar
+        """,
+    })
+    messages = [str(f) for f in _findings(tmp_path, "msg-handlers")]
+    assert any("Request_Bar" in m for m in messages), messages
+    assert not any("Request_Foo" in m or "Control_Ping" in m
+                   for m in messages)
+
+
+def test_msg_suppression_honored(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/runtime/message.py": """
+            from enum import IntEnum
+            class MsgType(IntEnum):
+                Control_Oneway = 40  # mvlint: ignore[msg-pairs,msg-handlers]
+        """,
+    })
+    assert _findings(tmp_path, "msg-pairs") == []
+    assert _findings(tmp_path, "msg-handlers") == []
+
+
+# ------------------------------------------------------- thread discipline
+
+
+def test_thread_discipline_wrong_thread(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/runtime/srv.py": """
+            import threading
+            from multiverso_tpu.runtime.contracts import dispatcher_only
+
+            class Srv:
+                def start(self):
+                    self._t = threading.Thread(target=self._main,
+                                               name="mv-server")
+                    self._w = threading.Thread(target=self._watch,
+                                               name="mv-watchdog")
+
+                def _main(self):
+                    self._apply()          # dispatcher: allowed
+
+                def _watch(self):
+                    self._apply()          # wrong thread: flagged
+
+                @dispatcher_only
+                def _apply(self):
+                    pass
+        """,
+    })
+    messages = [str(f) for f in _findings(tmp_path, "thread-discipline")]
+    assert len(messages) == 1, messages
+    assert "_watch" in messages[0] and "_apply" in messages[0]
+
+
+def test_thread_discipline_closure_is_not_an_edge(tmp_path):
+    # handing work to the dispatcher via a closure (run_serialized /
+    # Server_Execute idiom) must NOT count as calling it on this thread
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/runtime/srv.py": """
+            import threading
+            from multiverso_tpu.runtime.contracts import dispatcher_only
+
+            class Srv:
+                def start(self):
+                    self._w = threading.Thread(target=self._watch,
+                                               name="mv-watchdog")
+
+                def _watch(self):
+                    def run():
+                        self._apply()
+                    self.run_serialized(run)
+                    self.enqueue(lambda: self._apply())
+
+                def run_serialized(self, fn): ...
+                def enqueue(self, fn): ...
+
+                @dispatcher_only
+                def _apply(self):
+                    pass
+        """,
+    })
+    assert _findings(tmp_path, "thread-discipline") == []
+
+
+def test_slot_free_blocking_and_machinery(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/runtime/srv.py": """
+            import time
+            from multiverso_tpu.runtime.contracts import slot_free
+
+            class H:
+                @slot_free
+                def _reply_slow(self, msg):
+                    time.sleep(0.1)
+
+                @slot_free
+                def _reply_dirty(self, msg):
+                    self._dedup_store(msg)
+
+                @slot_free
+                def _reply_clean(self, msg):
+                    return self.render(msg)
+
+                def _dedup_store(self, msg): ...
+                def render(self, msg): ...
+        """,
+    })
+    messages = [str(f) for f in _findings(tmp_path, "slot-free")]
+    assert any("_reply_slow" in m and "time.sleep" in m for m in messages)
+    assert any("_reply_dirty" in m and "_dedup_store" in m
+               for m in messages)
+    assert not any("_reply_clean" in m for m in messages)
+
+
+def test_lock_blocking_under_registry_lock(tmp_path):
+    _mini_repo(tmp_path, {
+        "multiverso_tpu/dash.py": """
+            import time, threading
+
+            class Dashboard:
+                def bad_snapshot(self):
+                    with self._lock:
+                        time.sleep(0.5)
+
+                def good_snapshot(self):
+                    with self._lock:
+                        data = dict(self._metrics)
+                    time.sleep(0.5)
+                    return data
+
+            class NotARegistry:
+                def fine(self):
+                    with self._lock:
+                        time.sleep(0.5)
+        """,
+    })
+    messages = [str(f) for f in _findings(tmp_path, "lock-blocking")]
+    assert len(messages) == 1, messages
+    assert "bad_snapshot" in messages[0]
+
+
+# ----------------------------------------------------------------- repo
+
+
+def test_repo_lints_clean():
+    """The gate `make lint` enforces: the real repo has zero findings."""
+    findings = run(REPO_ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# -------------------------------------------------------------- lockcheck
+
+
+@pytest.fixture
+def lockcheck_session():
+    from multiverso_tpu.fault import lockcheck
+    was_enabled = lockcheck.enabled()
+    lockcheck.enable()
+    yield lockcheck
+    lockcheck.take_findings()
+    if not was_enabled:
+        lockcheck.disable()
+
+
+def test_lockcheck_reports_ab_ba_cycle_across_threads(lockcheck_session):
+    lockcheck = lockcheck_session
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def forward():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def backward():
+        with lock_b:
+            with lock_a:
+                pass
+
+    # sequenced (join between) so the inversion is recorded without any
+    # risk of the test actually deadlocking
+    t1 = threading.Thread(target=forward, name="t-forward")
+    t1.start()
+    t1.join(5.0)
+    t2 = threading.Thread(target=backward, name="t-backward")
+    t2.start()
+    t2.join(5.0)
+
+    cycles = [f for f in lockcheck.take_findings()
+              if f["kind"] == "lock_order_cycle"]
+    assert len(cycles) == 1, cycles
+    report = cycles[0]
+    assert report["thread"] == "t-backward"
+    # both creation sites appear in the cycle, and both stacks shipped
+    assert len(report["locks"]) >= 2
+    assert "backward" in report["acquire_stack"]
+    assert report["held_stack"]
+
+
+def test_lockcheck_consistent_order_is_clean(lockcheck_session):
+    lockcheck = lockcheck_session
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    for _ in range(5):
+        with lock_a:
+            with lock_b:
+                pass
+    assert [f for f in lockcheck.take_findings()
+            if f["kind"] == "lock_order_cycle"] == []
+
+
+def test_lockcheck_hold_time_outlier(lockcheck_session, monkeypatch):
+    lockcheck = lockcheck_session
+    monkeypatch.setenv("MV_LOCKCHECK_HOLD_SECONDS", "0.01")
+    lock = threading.Lock()
+    with lock:
+        time.sleep(0.05)
+    outliers = [f for f in lockcheck.take_findings()
+                if f["kind"] == "lock_hold_outlier"]
+    assert len(outliers) == 1, outliers
+    assert outliers[0]["held_seconds"] >= 0.05
+    assert outliers[0]["threshold"] == 0.01
+
+
+def test_lockcheck_rlock_and_condition_still_work(lockcheck_session):
+    lockcheck = lockcheck_session
+    rlock = threading.RLock()
+    with rlock:
+        with rlock:  # reentrant: no self-edge, no finding
+            pass
+    cond = threading.Condition()
+    flag = []
+
+    def setter():
+        with cond:
+            flag.append(1)
+            cond.notify_all()
+
+    t = threading.Thread(target=setter)
+    with cond:
+        t.start()
+        assert cond.wait_for(lambda: flag, timeout=5.0)
+    t.join(5.0)
+    assert [f for f in lockcheck.take_findings()
+            if f["kind"] == "lock_order_cycle"] == []
+
+
+# -------------------------------------------------------------- contracts
+
+
+def test_dispatcher_only_enforcement():
+    from multiverso_tpu.runtime import contracts
+
+    calls = []
+
+    class Obj:
+        @contracts.dispatcher_only
+        def apply(self):
+            calls.append(threading.current_thread().name)
+
+    obj = Obj()
+    obj.apply()  # no dispatcher thread alive: exempt
+    assert calls == ["MainThread"]
+
+    stop = threading.Event()
+    dispatcher = threading.Thread(target=stop.wait, name="mv-server")
+    dispatcher.start()
+    contracts.set_enforce(True)
+    try:
+        with pytest.raises(contracts.ContractViolation):
+            obj.apply()
+    finally:
+        contracts.set_enforce(False)
+        stop.set()
+        dispatcher.join(5.0)
+    obj.apply()  # enforcement off again
+    assert len(calls) == 2

@@ -281,8 +281,8 @@ def test_slo_burn_alert_fires_once_and_dumps(tmp_path):
     # edge-triggered: still burning does not re-alert or re-dump
     engine.evaluate_now()
     assert Dashboard.counter_value("SLO_BURN_ALERTS") == 1
-    lines = [json.loads(line) for line in
-             open(path, encoding="utf-8") if line.strip()]
+    with open(path, encoding="utf-8") as fh:
+        lines = [json.loads(line) for line in fh if line.strip()]
     events = [l for l in lines if l["kind"] == "event"]
     assert len(events) == 1
     assert events[0]["reason"] == "slo_burn"
@@ -330,10 +330,12 @@ def test_bench_compare_verdicts_and_exit_codes(tmp_path):
     bad = {**a, "ps_words_per_sec": 70_000.0, "ps_get_p99_us": 80.0}
     pa, pok, pbad = (str(tmp_path / f"{n}.json")
                      for n in ("a", "ok", "bad"))
-    json.dump(a, open(pa, "w"))
-    json.dump(ok, open(pok, "w"))
-    # candidate may arrive as a BENCH_r*.json round wrapper
-    json.dump({"n": 9, "rc": 0, "parsed": bad}, open(pbad, "w"))
+    for payload, dst in ((a, pa), (ok, pok),
+                         # candidate may arrive as a BENCH_r*.json
+                         # round wrapper
+                         ({"n": 9, "rc": 0, "parsed": bad}, pbad)):
+        with open(dst, "w") as fh:
+            json.dump(payload, fh)
     assert bench.bench_compare(pa, pok, threshold=0.10) == []
     regressed = bench.bench_compare(pa, pbad, threshold=0.10)
     assert set(regressed) == {"ps_words_per_sec", "ps_get_p99_us"}
@@ -499,8 +501,8 @@ def test_slo_burn_fires_under_chaos_injected_delay(tmp_path):
         f"burn the 10ms objective")
     assert ev.value_short >= 0.05
     assert Dashboard.counter_value("SLO_BURN_ALERTS") == 1
-    lines = [json.loads(line) for line in
-             open(path, encoding="utf-8") if line.strip()]
+    with open(path, encoding="utf-8") as fh:
+        lines = [json.loads(line) for line in fh if line.strip()]
     events = [l for l in lines if l["kind"] == "event"]
     assert any(e["reason"] == "slo_burn" and e["slo"] == "get_p99"
                for e in events), events

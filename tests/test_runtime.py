@@ -14,7 +14,7 @@ from multiverso_tpu.runtime.node import Node, Role
 def test_msg_type_signs():
     assert MsgType.Request_Get.is_server_bound
     assert MsgType.Reply_Get.is_worker_bound
-    assert MsgType.Control_Barrier.is_control
+    assert MsgType.Control_Register.is_control
     assert not MsgType.Request_Add.is_control
 
 

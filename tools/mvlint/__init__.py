@@ -1,0 +1,22 @@
+"""mvlint — project-invariant static analysis for multiverso_tpu.
+
+Run ``python -m tools.mvlint`` from the repo root (or ``make lint``).
+See ``docs/static_analysis.md`` for the rule catalog and suppression
+syntax.
+"""
+
+from tools.mvlint.core import Finding, Project, RULES, rule  # noqa: F401
+from tools.mvlint import rules_registry  # noqa: F401  (registers rules)
+from tools.mvlint import rules_threads  # noqa: F401  (registers rules)
+
+
+def run(root, rules=None):
+    """Run the selected rules (default: all) over the repo at ``root``;
+    returns the findings sorted by file/line."""
+    project = Project(root)
+    selected = rules or sorted(RULES)
+    findings = []
+    for name in selected:
+        findings.extend(RULES[name](project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

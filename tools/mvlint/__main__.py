@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.mvlint [--root DIR] [--rules a,b] [--list-rules]``.
+
+Exit status 0 when clean, 1 when any finding survives suppression —
+``make lint`` and the CI lint step key off that.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.mvlint import RULES, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mvlint",
+        description="project-invariant static analysis for multiverso_tpu")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: the repo containing "
+                             "this tool)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule subset (default: all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            doc = (RULES[name].__doc__ or "").strip().splitlines()
+            print("%-20s %s" % (name, doc[0] if doc else ""))
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print("mvlint: unknown rule(s): %s (try --list-rules)" %
+                  ", ".join(unknown), file=sys.stderr)
+            return 2
+
+    findings = run(root, rules)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print("\nmvlint: %d finding(s)" % len(findings), file=sys.stderr)
+        return 1
+    print("mvlint: clean (%d rule(s))" % len(rules or RULES))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

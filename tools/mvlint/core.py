@@ -1,0 +1,144 @@
+"""mvlint core: project loading, findings, suppressions, rule registry.
+
+mvlint is an AST-based checker for *project-specific* invariants — the
+conventions (metric catalog, flag registry, message-type pairing,
+thread discipline) that generic linters cannot know about.  Rules live
+in :mod:`tools.mvlint.rules_registry` and
+:mod:`tools.mvlint.rules_threads`; each is a function
+``rule(project) -> list[Finding]`` registered under a kebab-case name.
+
+Suppressions: a finding anchored at a line whose text contains
+``# mvlint: ignore[rule]`` (or ``ignore[rule-a,rule-b]`` /
+``ignore[all]``) is dropped.  Suppressions are line-scoped on purpose —
+a rule can only be waived where the reviewer can read the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+SUPPRESS_RE = re.compile(r"mvlint:\s*ignore\[([a-z0-9_\-, ]+)\]")
+
+#: Directories never scanned (the linter's own fixtures would otherwise
+#: trip the rules they demonstrate).
+EXCLUDE_PARTS = {".git", "__pycache__", "tools", "native", "build",
+                 ".venv", "node_modules"}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str      # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return "%s:%d: [%s] %s" % (self.path, self.line, self.rule,
+                                   self.message)
+
+
+class Source:
+    """One scanned file: raw lines always, AST when it is Python."""
+
+    def __init__(self, root: Path, path: Path) -> None:
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        if path.suffix == ".py":
+            try:
+                self.tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError:
+                pass  # reported by the syntax rule in __main__
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if 1 <= line <= len(self.lines):
+            m = SUPPRESS_RE.search(self.lines[line - 1])
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                return rule in rules or "all" in rules
+        return False
+
+
+class Project:
+    """The scanned repo: all Python sources plus the metric-catalog doc.
+
+    ``package`` names the production package (rules that declare
+    invariants — metric emits, flag defines, message types — only scan
+    it); flag *reads* and thread spawns are collected repo-wide.
+    """
+
+    def __init__(self, root, package: str = "multiverso_tpu") -> None:
+        self.root = Path(root)
+        self.package = package
+        self.sources: Dict[str, Source] = {}
+        for path in sorted(self.root.rglob("*.py")):
+            parts = set(path.relative_to(self.root).parts[:-1])
+            if parts & EXCLUDE_PARTS:
+                continue
+            src = Source(self.root, path)
+            self.sources[src.rel] = src
+        doc = self.root / "docs" / "observability.md"
+        self.metric_doc: Optional[Source] = (
+            Source(self.root, doc) if doc.exists() else None)
+
+    def package_sources(self) -> List[Source]:
+        prefix = self.package + "/"
+        return [s for rel, s in self.sources.items()
+                if rel.startswith(prefix) or rel == self.package + ".py"]
+
+    def py_sources(self) -> List[Source]:
+        return [s for s in self.sources.values() if s.tree is not None]
+
+    def emit(self, findings: List[Finding], rule: str, src: Source,
+             line: int, message: str) -> None:
+        """Append a finding unless the anchor line suppresses the rule."""
+        if not src.suppressed(line, rule):
+            findings.append(Finding(rule, src.rel, line, message))
+
+
+RULES: Dict[str, Callable[[Project], List[Finding]]] = {}
+
+
+def rule(name: str) -> Callable:
+    def deco(fn: Callable[[Project], List[Finding]]) -> Callable:
+        RULES[name] = fn
+        return fn
+    return deco
+
+
+def first_str_arg(call: ast.Call):
+    """The call's first positional argument if it is a string literal or
+    an f-string; f-strings canonicalize to ``<*>`` wildcard patterns.
+    Returns None for dynamic (variable) names."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("<*>")
+        return "".join(parts)
+    return None
+
+
+def canonical(name: str) -> str:
+    """Collapse every ``<...>`` placeholder to ``<*>`` so a code-side
+    f-string pattern and a doc-side ``NAME_W<id>`` entry compare equal."""
+    return re.sub(r"<[^>]*>", "<*>", name)
+
+
+def pattern_matches(pattern: str, literal: str) -> bool:
+    """True when a canonical ``<*>``-pattern matches a literal name."""
+    regex = "".join(".+" if part == "<*>" else re.escape(part)
+                    for part in re.split(r"(<\*>)", pattern))
+    return re.fullmatch(regex, literal) is not None

@@ -1,0 +1,267 @@
+"""Registry-consistency rules: metrics vs. docs, flags, message types.
+
+These check the three convention-only registries the runtime grew:
+
+``metrics-docs``
+    Every metric name emitted in the package (``count``/``observe``/
+    ``gauge_set``/``gauge_add``/``monitor`` helpers, or direct
+    ``Dashboard.counter/histogram/gauge`` registration) must appear in
+    the "Metric catalog" section of ``docs/observability.md`` — and
+    every catalog entry must still have an emitting site (no phantom
+    metrics surviving a refactor).  F-string names canonicalize to
+    ``<*>`` wildcards and match ``NAME_W<id>``-style catalog patterns.
+
+``flags``
+    Every flag read (``get_flag``) must be declared by a module-level
+    ``define_*`` in the package, and every declared flag must be read
+    somewhere in the repo (dead flags are config rot).
+
+``msg-pairs`` / ``msg-handlers``
+    Every ``Request_X``/``Control_X`` member of ``MsgType`` must have
+    its ``Reply_X``/``Control_Reply_X`` partner at the negated value,
+    and every positive (server/control-bound) member must appear in a
+    dispatch position (a comparison or dispatch-dict key) outside
+    ``message.py`` — a member nobody dispatches is a dead wire type.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from tools.mvlint.core import (Finding, Project, Source, canonical,
+                               first_str_arg, pattern_matches, rule)
+
+EMIT_FUNCS = {"count", "observe", "gauge_set", "gauge_add", "monitor"}
+EMIT_METHODS = {"counter", "histogram", "gauge"}
+CATALOG_HEADING = "metric catalog"
+METRIC_TOKEN = re.compile(r"`([A-Z][A-Z0-9_]*(?:<[^`>]+>[A-Z0-9_]*)*)`")
+
+DEFINE_FUNCS = {"define_int", "define_bool", "define_string",
+                "define_double"}
+
+
+def _metric_emits(project: Project) -> List[Tuple[str, Source, int]]:
+    out: List[Tuple[str, Source, int]] = []
+    for src in project.package_sources():
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_emit = (isinstance(fn, ast.Name) and fn.id in EMIT_FUNCS) or \
+                (isinstance(fn, ast.Attribute) and fn.attr in EMIT_METHODS
+                 and isinstance(fn.value, ast.Name)
+                 and fn.value.id == "Dashboard")
+            if not is_emit:
+                continue
+            name = first_str_arg(node)
+            if name is None:      # dynamic name: not statically checkable
+                continue
+            out.append((name, src, node.lineno))
+    return out
+
+
+def _catalog_names(project: Project) -> Dict[str, int]:
+    """Catalog entries (canonical name -> first line) from the metric
+    catalog section of docs/observability.md."""
+    doc = project.metric_doc
+    if doc is None:
+        return {}
+    names: Dict[str, int] = {}
+    in_section = False
+    for idx, line in enumerate(doc.lines, start=1):
+        if line.startswith("## "):
+            in_section = CATALOG_HEADING in line.lower()
+            continue
+        if not in_section:
+            continue
+        for m in METRIC_TOKEN.finditer(line):
+            if m.group(1).startswith("MV_"):
+                continue  # MV_* is the env-hook namespace, never a metric
+            names.setdefault(canonical(m.group(1)), idx)
+    return names
+
+
+@rule("metrics-docs")
+def check_metrics_docs(project: Project) -> List[Finding]:
+    """Every emitted metric is catalogued in docs/observability.md and vice versa."""
+    findings: List[Finding] = []
+    doc = project.metric_doc
+    if doc is None:
+        return findings
+    catalog = _catalog_names(project)
+    emits = _metric_emits(project)
+    emitted: Set[str] = set()
+    for name, src, line in emits:
+        cname = canonical(name)
+        emitted.add(cname)
+        documented = cname in catalog or any(
+            "<*>" in entry and pattern_matches(entry, cname)
+            for entry in catalog)
+        if not documented:
+            project.emit(findings, "metrics-docs", src, line,
+                         "metric %r is emitted here but missing from the "
+                         "docs/observability.md metric catalog" % name)
+    for entry, doc_line in sorted(catalog.items()):
+        live = entry in emitted or (
+            "<*>" in entry and any(pattern_matches(entry, e)
+                                   for e in emitted)) or (
+            any("<*>" in e and pattern_matches(e, entry) for e in emitted))
+        if not live:
+            project.emit(findings, "metrics-docs", doc, doc_line,
+                         "catalog entry %r has no emitting code site "
+                         "(phantom metric)" % entry)
+    return findings
+
+
+def _is_define(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in DEFINE_FUNCS
+    return isinstance(fn, ast.Attribute) and fn.attr in DEFINE_FUNCS
+
+
+@rule("flags")
+def check_flags(project: Project) -> List[Finding]:
+    """Every flag read is declared, every declared flag is read somewhere."""
+    findings: List[Finding] = []
+    defined: Dict[str, Tuple[Source, int]] = {}
+    for src in project.package_sources():
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _is_define(node):
+                name = first_str_arg(node)
+                if name is not None:
+                    defined.setdefault(name, (src, node.lineno))
+    reads: Dict[str, List[Tuple[Source, int]]] = {}
+    for src in project.py_sources():
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_read = (isinstance(fn, ast.Name) and fn.id == "get_flag") or \
+                (isinstance(fn, ast.Attribute) and fn.attr == "get_flag")
+            if not is_read:
+                continue
+            name = first_str_arg(node)
+            if name is not None:
+                reads.setdefault(name, []).append((src, node.lineno))
+    for name, sites in sorted(reads.items()):
+        if name not in defined:
+            src, line = sites[0]
+            project.emit(findings, "flags", src, line,
+                         "flag %r is read but never declared by a "
+                         "define_* in %s" % (name, project.package))
+    for name, (src, line) in sorted(defined.items()):
+        if name not in reads:
+            project.emit(findings, "flags", src, line,
+                         "flag %r is declared but never read "
+                         "(dead flag)" % name)
+    return findings
+
+
+def _msgtype_members(project: Project):
+    """(source, {name: (value, line)}) for the MsgType enum, or None."""
+    for src in project.package_sources():
+        if src.tree is None or not src.rel.endswith("runtime/message.py"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "MsgType":
+                members: Dict[str, Tuple[int, int]] = {}
+                for stmt in node.body:
+                    if not (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)):
+                        continue
+                    try:
+                        value = ast.literal_eval(stmt.value)
+                    except ValueError:
+                        continue
+                    if isinstance(value, int):
+                        members[stmt.targets[0].id] = (value, stmt.lineno)
+                return src, members
+    return None
+
+
+@rule("msg-pairs")
+def check_msg_pairs(project: Project) -> List[Finding]:
+    """Every Request_*/Control_* MsgType has its Reply partner at the negated value."""
+    findings: List[Finding] = []
+    found = _msgtype_members(project)
+    if found is None:
+        return findings
+    src, members = found
+    for name, (value, line) in sorted(members.items()):
+        if value <= 0:
+            continue
+        if name.startswith("Request_"):
+            partner = "Reply_" + name[len("Request_"):]
+        elif name.startswith("Control_") and \
+                not name.startswith("Control_Reply_"):
+            partner = "Control_Reply_" + name[len("Control_"):]
+        else:
+            continue
+        if partner not in members:
+            project.emit(findings, "msg-pairs", src, line,
+                         "message type %s has no %s partner" %
+                         (name, partner))
+        elif members[partner][0] != -value:
+            project.emit(findings, "msg-pairs", src, line,
+                         "%s = %d but %s = %d (reply values must negate "
+                         "their request)" %
+                         (name, value, partner, members[partner][0]))
+    return findings
+
+
+def _dispatch_refs(project: Project) -> Set[str]:
+    """MsgType member names referenced in a dispatch position (a
+    comparison operand or a dict key) outside message.py."""
+    refs: Set[str] = set()
+
+    def collect(expr: ast.AST) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "MsgType":
+                refs.add(sub.attr)
+
+    for src in project.package_sources():
+        if src.tree is None or src.rel.endswith("runtime/message.py"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Compare):
+                collect(node.left)
+                for comparator in node.comparators:
+                    collect(comparator)
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        collect(key)
+            elif isinstance(node, ast.Match):
+                for case in node.cases:
+                    collect(case.pattern)
+    return refs
+
+
+@rule("msg-handlers")
+def check_msg_handlers(project: Project) -> List[Finding]:
+    """Every positive MsgType member has a dispatch site outside message.py."""
+    findings: List[Finding] = []
+    found = _msgtype_members(project)
+    if found is None:
+        return findings
+    src, members = found
+    refs = _dispatch_refs(project)
+    for name, (value, line) in sorted(members.items()):
+        if value <= 0:
+            continue
+        if name not in refs:
+            project.emit(findings, "msg-handlers", src, line,
+                         "positive message type %s (%d) is never "
+                         "dispatched (no comparison/dispatch-key "
+                         "reference outside message.py)" % (name, value))
+    return findings

@@ -1,0 +1,386 @@
+"""Thread-discipline rules: dispatcher-only reachability, slot-free
+handlers, and blocking calls under registry locks.
+
+The runtime's contracts are declared with the decorators in
+``multiverso_tpu/runtime/contracts.py``; these rules check them
+statically over an approximate call graph:
+
+- Functions are AST ``def`` nodes keyed by qualname.  Nested ``def``s
+  and lambdas are **separate scopes**, never edges from their enclosing
+  function — the runtime's idiom for crossing onto the dispatcher
+  thread is exactly "wrap the work in a closure and hand it to
+  ``run_serialized``/``Server_Execute``", so a closure's body must not
+  be attributed to the thread that *created* it.
+- ``self.m()`` resolves within the class then up its (project-local)
+  bases; bare ``f()`` resolves to a module-level function.  Calls on
+  other objects resolve only when the method name is contract-marked
+  and distinctive (not a ubiquitous name like ``append``/``get``), so
+  cross-object contract violations are caught without drowning in
+  aliasing noise.
+- Thread roots are ``threading.Thread(target=...)`` sites.  A root
+  whose ``name=`` starts with ``mv-server`` is the dispatcher itself
+  and is allowed to reach ``@dispatcher_only`` functions; every other
+  root is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.mvlint.core import Finding, Project, Source, rule
+
+DISPATCHER_THREAD_PREFIX = "mv-server"
+
+#: Contract-marked method names too generic to resolve across objects.
+COMMON_NAMES = {"append", "get", "add", "send", "pop", "put", "run",
+                "close", "start", "stop", "flush", "write", "read",
+                "update", "wait", "set"}
+
+#: Slot/lease/dedup machinery a @slot_free handler must not reach.
+SLOT_MACHINERY = {"_replayed", "_dedup_store", "seed_dedup",
+                  "_register_client", "_resume_slot", "_reap_leases",
+                  "_evict_worker"}
+
+#: Attribute calls that block the calling thread.
+BLOCKING_ATTRS = {"accept", "recv", "recv_into", "pop_all"}
+
+#: Classes whose ``self._lock`` is a process-global registry lock: any
+#: blocking call while holding one stalls every reader in the process.
+#: (FlightRecorder intentionally serializes its dump I/O under its own
+#: lock and is excluded — dumps are rare and must not interleave.)
+REGISTRY_CLASSES = {"Dashboard", "FlagRegistry", "TraceStore",
+                    "TimeSeriesRecorder"}
+
+
+@dataclass
+class FuncInfo:
+    qualname: str            # module-relative, e.g. "Server._process_add"
+    name: str
+    cls: Optional[str]
+    src: Source
+    node: ast.AST
+    contract: Optional[str]  # "dispatcher_only" | "slot_free" | None
+    calls: List[ast.expr] = field(default_factory=list)
+
+
+def _decorator_contract(node) -> Optional[str]:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = None
+        if isinstance(target, ast.Name):
+            name = target.id
+        elif isinstance(target, ast.Attribute):
+            name = target.attr
+        if name in ("dispatcher_only", "slot_free"):
+            return name
+    return None
+
+
+class _CallCollector(ast.NodeVisitor):
+    """Call expressions in one function body, excluding nested scopes."""
+
+    def __init__(self, root) -> None:
+        self.root = root
+        self.calls: List[ast.expr] = []
+
+    def visit_FunctionDef(self, node) -> None:
+        if node is self.root:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node) -> None:
+        pass  # separate scope
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.calls.append(node)
+        self.generic_visit(node)
+
+
+class CallGraph:
+    """Project-wide approximate call graph + thread-spawn roots."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}  # (rel, qualname)
+        self.bases: Dict[str, List[str]] = {}             # class -> bases
+        self.by_class: Dict[Tuple[str, str], Dict[str, FuncInfo]] = {}
+        self.by_module: Dict[str, Dict[str, FuncInfo]] = {}
+        # thread spawn sites: (src, line, target_funcs, thread_name)
+        self.roots: List[Tuple[Source, int, List[FuncInfo],
+                               Optional[str]]] = []
+        for src in project.package_sources():
+            if src.tree is not None:
+                self._collect_defs(src)
+        for src in project.package_sources():
+            if src.tree is not None:
+                self._collect_roots(src)
+        self.marked: Dict[str, List[FuncInfo]] = {}
+        for info in self.funcs.values():
+            if info.contract is not None:
+                self.marked.setdefault(info.name, []).append(info)
+
+    # -- collection --------------------------------------------------
+    def _collect_defs(self, src: Source) -> None:
+        module = self.by_module.setdefault(src.rel, {})
+
+        def visit_body(body, cls: Optional[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    qual = "%s.%s" % (cls, stmt.name) if cls else stmt.name
+                    collector = _CallCollector(stmt)
+                    collector.visit(stmt)
+                    info = FuncInfo(qual, stmt.name, cls, src, stmt,
+                                    _decorator_contract(stmt),
+                                    collector.calls)
+                    self.funcs[(src.rel, qual)] = info
+                    if cls:
+                        self.by_class.setdefault((src.rel, cls),
+                                                 {})[stmt.name] = info
+                    else:
+                        module[stmt.name] = info
+                elif isinstance(stmt, ast.ClassDef):
+                    self.bases[stmt.name] = [
+                        b.id for b in stmt.bases if isinstance(b, ast.Name)]
+                    visit_body(stmt.body, stmt.name)
+
+        visit_body(src.tree.body, None)
+
+    def _method(self, src: Source, cls: Optional[str],
+                name: str) -> Optional[FuncInfo]:
+        """Resolve a method by walking the class then its bases (by name,
+        searching every module — subclasses live across files)."""
+        seen: Set[str] = set()
+        queue = [cls] if cls else []
+        while queue:
+            current = queue.pop(0)
+            if current is None or current in seen:
+                continue
+            seen.add(current)
+            info = self.by_class.get((src.rel, current), {}).get(name)
+            if info is None:
+                for (_rel, c), methods in self.by_class.items():
+                    if c == current and name in methods:
+                        info = methods[name]
+                        break
+            if info is not None:
+                return info
+            queue.extend(self.bases.get(current, []))
+        return None
+
+    def _resolve(self, call: ast.expr, info: FuncInfo) -> List[FuncInfo]:
+        fn = call.func if isinstance(call, ast.Call) else call
+        if isinstance(fn, ast.Name):
+            target = self.by_module.get(info.src.rel, {}).get(fn.id)
+            return [target] if target else []
+        if isinstance(fn, ast.Attribute):
+            if isinstance(fn.value, ast.Name) and \
+                    fn.value.id in ("self", "cls") and info.cls:
+                target = self._method(info.src, info.cls, fn.attr)
+                if target:
+                    return [target]
+            # cross-object: only distinctive contract-marked names
+            if fn.attr in self.marked and fn.attr not in COMMON_NAMES:
+                return list(self.marked[fn.attr])
+        return []
+
+    def edges(self, info: FuncInfo) -> List[FuncInfo]:
+        out: List[FuncInfo] = []
+        for call in info.calls:
+            out.extend(self._resolve(call, info))
+        return out
+
+    def reach(self, start: FuncInfo):
+        """BFS: {reached FuncInfo: parent} including start (parent None)."""
+        parents: Dict[Tuple[str, str], Optional[FuncInfo]] = {}
+        key = (start.src.rel, start.qualname)
+        parents[key] = None
+        queue = [start]
+        reached: Dict[Tuple[str, str], FuncInfo] = {key: start}
+        while queue:
+            current = queue.pop(0)
+            for nxt in self.edges(current):
+                k = (nxt.src.rel, nxt.qualname)
+                if k in reached:
+                    continue
+                reached[k] = nxt
+                parents[k] = current
+                queue.append(nxt)
+        return reached, parents
+
+    def path(self, parents, target: FuncInfo) -> str:
+        names = [target.qualname]
+        key = (target.src.rel, target.qualname)
+        while parents.get(key) is not None:
+            parent = parents[key]
+            names.append(parent.qualname)
+            key = (parent.src.rel, parent.qualname)
+        return " -> ".join(reversed(names))
+
+    # -- thread roots ------------------------------------------------
+    def _collect_roots(self, src: Source) -> None:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_thread = (isinstance(fn, ast.Attribute)
+                         and fn.attr == "Thread") or \
+                (isinstance(fn, ast.Name) and fn.id == "Thread")
+            if not is_thread:
+                continue
+            target_expr = None
+            thread_name = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+                elif kw.arg == "name" and \
+                        isinstance(kw.value, ast.Constant):
+                    thread_name = str(kw.value.value)
+            if target_expr is None:
+                continue
+            enclosing = self._enclosing(src, node)
+            targets = self._thread_targets(src, enclosing, target_expr)
+            self.roots.append((src, node.lineno, targets, thread_name))
+
+    def _enclosing(self, src: Source, node: ast.AST) -> Optional[FuncInfo]:
+        best = None
+        for info in self.funcs.values():
+            if info.src is not src:
+                continue
+            fnode = info.node
+            if fnode.lineno <= node.lineno <= \
+                    (fnode.end_lineno or fnode.lineno):
+                if best is None or fnode.lineno > best.node.lineno:
+                    best = info
+        return best
+
+    def _thread_targets(self, src: Source, enclosing: Optional[FuncInfo],
+                        expr: ast.expr) -> List[FuncInfo]:
+        cls = enclosing.cls if enclosing else None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and cls:
+            target = self._method(src, cls, expr.attr)
+            return [target] if target else []
+        if isinstance(expr, ast.Name):
+            target = self.by_module.get(src.rel, {}).get(expr.id)
+            if target:
+                return [target]
+        # target is a variable (e.g. a (target, name) table the spawner
+        # iterates): fall back to every self-method the spawning function
+        # references, which over-approximates the possible targets
+        if enclosing is not None and cls:
+            out: List[FuncInfo] = []
+            for sub in ast.walk(enclosing.node):
+                if isinstance(sub, ast.Attribute) and \
+                        isinstance(sub.value, ast.Name) and \
+                        sub.value.id == "self":
+                    target = self._method(src, cls, sub.attr)
+                    if target and target not in out:
+                        out.append(target)
+            return out
+        return []
+
+
+@rule("thread-discipline")
+def check_thread_discipline(project: Project) -> List[Finding]:
+    """No non-dispatcher thread root reaches a @dispatcher_only function."""
+    findings: List[Finding] = []
+    graph = CallGraph(project)
+    for src, line, targets, thread_name in graph.roots:
+        if thread_name and thread_name.startswith(
+                DISPATCHER_THREAD_PREFIX):
+            continue  # the dispatcher may reach @dispatcher_only
+        for target in targets:
+            reached, parents = graph.reach(target)
+            for info in reached.values():
+                if info.contract == "dispatcher_only":
+                    project.emit(
+                        findings, "thread-discipline", src, line,
+                        "thread %r (target %s) reaches @dispatcher_only "
+                        "%s via %s" %
+                        (thread_name or "<unnamed>", target.qualname,
+                         info.qualname, graph.path(parents, info)))
+    return findings
+
+
+@rule("slot-free")
+def check_slot_free(project: Project) -> List[Finding]:
+    """@slot_free handlers stay off slot/lease/dedup machinery and never block."""
+    findings: List[Finding] = []
+    graph = CallGraph(project)
+    for info in graph.funcs.values():
+        if info.contract != "slot_free":
+            continue
+        reached, parents = graph.reach(info)
+        for target in reached.values():
+            if target is not info and target.name in SLOT_MACHINERY:
+                project.emit(
+                    findings, "slot-free", info.src, info.node.lineno,
+                    "@slot_free %s reaches slot/lease/dedup machinery "
+                    "%s via %s" % (info.qualname, target.qualname,
+                                   graph.path(parents, target)))
+        # blocking calls anywhere in the reachable bodies
+        for target in reached.values():
+            for call, desc in _blocking_calls(target):
+                project.emit(
+                    findings, "slot-free", target.src, call.lineno,
+                    "@slot_free %s executes blocking call %s (via %s)" %
+                    (info.qualname, desc,
+                     graph.path(parents, target)))
+    return findings
+
+
+def _blocking_calls(info: FuncInfo):
+    out = []
+    for call in info.calls:
+        fn = call.func if isinstance(call, ast.Call) else call
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "time":
+                out.append((call, "time.sleep"))
+            elif fn.attr in BLOCKING_ATTRS:
+                out.append((call, "." + fn.attr + "()"))
+    return out
+
+
+@rule("lock-blocking")
+def check_lock_blocking(project: Project) -> List[Finding]:
+    """Blocking calls while holding a registry lock."""
+    findings: List[Finding] = []
+    graph = CallGraph(project)
+    for (rel, cls), methods in graph.by_class.items():
+        if cls not in REGISTRY_CLASSES:
+            continue
+        for info in methods.values():
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.With):
+                    continue
+                if not _holds_self_lock(node):
+                    continue
+                body_calls = []
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call):
+                            body_calls.append(sub)
+                probe = FuncInfo(info.qualname, info.name, cls, info.src,
+                                 info.node, None, body_calls)
+                for call, desc in _blocking_calls(probe):
+                    project.emit(
+                        findings, "lock-blocking", info.src, call.lineno,
+                        "%s.%s makes blocking call %s while holding the "
+                        "%s registry lock" % (cls, info.name, desc, cls))
+    return findings
+
+
+def _holds_self_lock(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) and expr.attr in \
+                ("_lock", "_mutex") and isinstance(expr.value, ast.Name) \
+                and expr.value.id in ("self", "cls"):
+            return True
+    return False
