@@ -19,6 +19,11 @@
 #   make replicas       read-replica smoke: budget-bound watermark-stamped
 #                       reads off a replica fleet + SIGKILL-a-replica
 #                       failover drill (docs/serving.md)
+#   make reshard        elastic-membership smoke: live split/merge/move
+#                       under a write stream, zero acked-Add loss
+#                       (MV_RESHARD_KILL=donor|recipient|recipient_early
+#                       adds the participant-kill chaos drills;
+#                       docs/sharding.md §8)
 #   make metrics-smoke  short remote-training session; assert the metrics
 #                       JSONL parses and key latency histograms are non-empty
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
@@ -32,8 +37,8 @@ PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
-.PHONY: check lint chaos failover sharded replicas metrics-smoke native \
-	test dryrun bench apply-bench read-bench clean
+.PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
+	native test dryrun bench apply-bench read-bench clean
 
 check: lint native test dryrun bench
 
@@ -74,6 +79,10 @@ replicas:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \
 		tests/test_replica.py -q \
 		-k "staleness_property or sharded_replica or admission" \
+		-p no:cacheprovider -p no:randomly
+
+reshard:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_reshard.py -q \
 		-p no:cacheprovider -p no:randomly
 
 dryrun:

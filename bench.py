@@ -842,10 +842,12 @@ def _multihost_child(rank: int, world: int, coord: str, ctl: str,
     trains identical word2vec blocks through the PS path and reports its
     wall clock; rank != 0 also reports the median control-plane op cost
     (forward -> leader execute -> broadcast -> replay -> ack)."""
-    import jax
-    jax.config.update("jax_platforms", "cpu")
     if world > 1:
-        jax.distributed.initialize(f"127.0.0.1:{coord}", world, rank)
+        from multiverso_tpu.runtime.multihost import init_distributed_cpu
+        init_distributed_cpu(f"127.0.0.1:{coord}", world, rank)
+    else:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
 
     import multiverso_tpu as mv
     from multiverso_tpu.models.vocab import Dictionary

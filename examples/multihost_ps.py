@@ -25,8 +25,8 @@ sys.path.insert(0, REPO)
 def worker_main(rank: int, world: int, coord: str, ctl: str) -> None:
     """One JAX process of the world (run with argv: rank world coord ctl)."""
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(f"127.0.0.1:{coord}", world, rank)
+    from multiverso_tpu.runtime.multihost import init_distributed_cpu
+    init_distributed_cpu(f"127.0.0.1:{coord}", world, rank)
 
     import numpy as np
 

@@ -358,6 +358,18 @@ def serve_sharded(tables: Sequence[dict], shards: Optional[int] = None,
     return ShardGroup(tables, shards=shards, **kwargs).start()
 
 
+def reshard(group):
+    """An elastic-membership coordinator for a live, durable shard group:
+    ``mv.reshard(group).split(k)`` / ``.merge(k)`` / ``.move(k)`` migrate
+    key ranges under traffic with zero acknowledged-Add loss — fresh
+    joiner processes catch up over the donors' WAL streams, donors fence
+    at a watermark cutover, and clients re-route in flight
+    (:mod:`multiverso_tpu.shard.reshard`, docs/sharding.md §live
+    migration)."""
+    from multiverso_tpu.shard.reshard import MigrationCoordinator
+    return MigrationCoordinator(group)
+
+
 def shard_connect(endpoints: Any = None, timeout: float = 30.0):
     """Connect to an existing shard group: fetch the layout manifest from
     the first reachable member (``Control_Layout`` RPC), then build a

@@ -354,6 +354,20 @@ define_string("shard_endpoints", "",
               "group — mv.shard_connect() bootstraps the layout manifest "
               "from the first reachable member; entries are validated "
               "fail-fast")
+# Elastic membership / live key-range migration (shard/reshard.py:
+# split/merge/move under traffic; docs/sharding.md §live migration).
+define_bool("auto_reshard", False,
+            "let the hot-range detector EXECUTE the splits it proposes "
+            "(MigrationCoordinator.maybe_autosplit); off, detection only "
+            "proposes (RESHARD_PROPOSALS counter + log line)")
+define_double("reshard_hot_ratio", 3.0,
+              "hot-range detector threshold: a shard proposes for a split "
+              "when its request rate exceeds this multiple of the median "
+              "shard's rate over the observation window")
+define_double("reshard_min_qps", 50.0,
+              "hot-range detector floor: shards below this request rate "
+              "never propose a split regardless of skew (splitting an "
+              "idle group is churn, not balance)")
 # Read-replica serving tier (durable/standby.py serve loop + runtime/read.py
 # client-side cache and routing; docs/serving.md).
 define_int("replicas", 0,

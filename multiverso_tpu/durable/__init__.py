@@ -16,6 +16,11 @@ with the process. This package closes that:
   tails the primary's WAL over a replication stream, detects primary
   death by lease expiry, and binds the service endpoint so client
   reconnect logic resumes against it transparently.
+* :mod:`~multiverso_tpu.durable.migrate` — range-filtered WAL tailing
+  for live key-range migration (shard/reshard.py): a joining shard
+  absorbs a quiesced raw-value transfer of exactly the migrating
+  ranges, then tails the donor's record stream — translating donor ids
+  to its own — up to the cutover watermark.
 
 See docs/fault_tolerance.md §7 for the operator story.
 """
@@ -25,6 +30,8 @@ import os as _os
 from multiverso_tpu.durable.wal import (  # noqa: F401
     RecoveryResult, WalRecord, WalWriter, read_manifest, recover)
 from multiverso_tpu.durable.standby import WarmStandby  # noqa: F401
+from multiverso_tpu.durable.migrate import (  # noqa: F401
+    RangeTailer, translate_add)
 
 
 def shard_wal_dir(root: str, shard: int) -> str:

@@ -39,6 +39,14 @@ class MsgType(enum.IntEnum):
     Reply_Add = -2
     Reply_Read = -3
     Reply_Error = -5  # request failed server-side / peer connection lost
+    # stale-layout refusal (shard/reshard.py migration cutover): the
+    # request carried a layout version older than the shard's installed
+    # layout, so its routing may be wrong — the server REFUSES before
+    # applying and ships the new manifest in the reply payload so the
+    # router re-fetches and re-routes without an extra Control_Layout
+    # round trip. Reply-only by design: no positive wire type requests a
+    # refusal — it is the error arm of Request_Get/Request_Add
+    Reply_WrongShard = -6  # mvlint: ignore[msg-pairs]
     # control plane (>= 32 request, <= -32 reply).  Value 33 (the
     # reference repo's Control_Barrier) is retired: barriers are
     # threading.Barrier in-process and multihost.barrier() across hosts,
@@ -92,6 +100,23 @@ class MsgType(enum.IntEnum):
     # like the stats/watermark probes.
     Control_Traces = 43
     Control_Reply_Traces = -43
+    # live key-range migration (shard/reshard.py + durable/migrate.py): a
+    # joining shard subscribes to a donor's WAL restricted to the
+    # migrating id ranges; the reply carries a quiesced raw-value
+    # transfer of exactly those ranges plus the donor's WAL watermark,
+    # and the subscriber then tails Control_Wal_Record frames like a
+    # standby (filtering to its ranges client-side)
+    Control_Migrate = 44
+    Control_Reply_Migrate = -44
+    # migration cutover RPC: install the attached manifest (layout
+    # version bump — the donor starts refusing stale-stamped requests
+    # with Reply_WrongShard) and answer with the WAL seq after the
+    # dispatcher drain: every acknowledged Add is <= that watermark, so
+    # the recipient is caught up once its replay reaches it. Also the
+    # rollback vehicle: aborting a migration re-installs the old
+    # topology under a HIGHER version through the same RPC
+    Control_Migrate_Cutover = 45
+    Control_Reply_Migrate_Cutover = -45
 
     @property
     def is_server_bound(self) -> bool:

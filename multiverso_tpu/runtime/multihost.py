@@ -79,6 +79,27 @@ _UNIFORM_FLAGS = ("sync", "ssp_staleness", "deterministic", "local_workers",
                   "updater_type", "mesh_shape", "mesh_axes")
 
 
+def init_distributed_cpu(coordinator: str, world: int, rank: int) -> None:
+    """Form a multi-process JAX world on the CPU backend (tests, benches,
+    local examples). The default CPU collectives implementation cannot run
+    cross-process programs at all — every rank dies at the first sharded
+    ``device_put`` with "Multiprocess computations aren't implemented on
+    the CPU backend" — so select the gloo implementation first. Must run
+    BEFORE ``jax.distributed.initialize`` (the env-var spelling is read
+    too late and does not work). Real TPU worlds never call this: their
+    launcher owns ``jax.distributed`` coordinates and ICI needs no
+    substitute collectives."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jax: option absent; single-host still works
+        log.info("multihost: jax_cpu_collectives_implementation "
+                 "unavailable; cross-process CPU collectives may fail")
+    jax.distributed.initialize(coordinator, num_processes=world,
+                               process_id=rank)
+
+
 def _hello_key() -> bytes:
     token = str(config.get_flag("multihost_token"))
     return hashlib.sha256(b"mv-multihost-v2:" + token.encode()).digest()

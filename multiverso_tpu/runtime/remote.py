@@ -78,6 +78,20 @@ config.define_bool("wire_compression", True,
 _INFLIGHT = object()
 
 
+class WrongShardError(Exception):
+    """A Reply_WrongShard came back: the request was stamped with a layout
+    version older than the serving shard's installed layout, so it was
+    REFUSED before applying. Carries the server's layout version and the
+    new manifest so the shard router re-fetches and re-routes without an
+    extra Control_Layout round trip."""
+
+    def __init__(self, layout_version: int, manifest) -> None:
+        super().__init__(f"stale shard layout (server at version "
+                         f"{layout_version})")
+        self.layout_version = int(layout_version)
+        self.manifest = manifest
+
+
 class _NetCompletion:
     """Dispatcher completion that frames the result back over the wire and
     records it in the server's dedup window, so a replay of the same
@@ -190,6 +204,12 @@ class RemoteServer:
         # only after every member has bound its endpoint)
         self.layout: Optional[Dict[str, Any]] = None
         self.layout_path: str = ""
+        # live-migration layout fencing (shard/reshard.py): requests
+        # stamped with a layout version below this are refused with
+        # Reply_WrongShard instead of applied — the router re-fetches and
+        # re-routes. 0 = no fencing (unsharded servers, pre-migration
+        # groups); bumped only by a Control_Migrate_Cutover install.
+        self.layout_version: int = 0
 
     def append_watermark(self) -> int:
         """The primary's WAL append sequence (-1 when serving without
@@ -355,6 +375,78 @@ class RemoteServer:
                  "seed(s) transferred)", len(tables), len(dedup))
         self._ensure_standby_heartbeats()
 
+    # -- live key-range migration (shard/reshard.py) -------------------------
+    def _subscribe_migrate(self, msg: Message) -> None:
+        """Handle Control_Migrate: a joining shard asks for a quiesced
+        raw-value transfer of specific shard-local id ranges, then tails
+        this donor's WAL record stream like a standby (the subscriber
+        filters to its ranges; the donor fan-out stays one code path).
+        Snapshot + subscription happen in ONE dispatcher-serialized block
+        — no Add falls between the extracted values and the first tailed
+        record, the same zero-loss argument the standby transfer makes."""
+        wal = self._zoo.server.wal
+        if wal is None:
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Reply_Error,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode("live migration needs durability: start "
+                                 "the donor with the wal_dir flag")))
+            return
+        ranges = wire.decode(msg.data).get("tables", {})
+
+        def transfer():
+            tables = {}
+            for table_id, (lo, hi) in ranges.items():
+                table = self._zoo.server._tables[int(table_id)]
+                tables[int(table_id)] = table.extract_range(int(lo),
+                                                            int(hi))
+            with self._standby_lock:
+                if msg._conn not in self._standbys:
+                    self._standbys.append(msg._conn)
+            return tables, int(wal.seq)
+
+        tables, watermark = self._zoo.server.run_serialized(transfer)
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Migrate,
+            msg_id=msg.msg_id, req_id=msg.req_id, watermark=watermark,
+            data=wire.encode({"tables": tables, "watermark": watermark})))
+        log.info("remote: migration subscriber attached (%d range(s), "
+                 "watermark %d)", len(tables), watermark)
+        self._ensure_standby_heartbeats()
+
+    def _migrate_cutover(self, msg: Message) -> None:
+        """Handle Control_Migrate_Cutover: install the attached manifest
+        (the layout-version fence goes up) and answer with the WAL seq
+        after a dispatcher drain. Ordering is the whole correctness
+        argument: this handler runs on the pump thread — the ONLY thread
+        that enqueues wire requests — so once the fence is set here, no
+        further stale-stamped Add can enter the dispatcher; the
+        run_serialized barrier then drains everything already queued, so
+        every acknowledged Add on this donor has seq <= the returned
+        watermark and the record stream is silent above it. Also the
+        rollback vehicle: aborting re-installs the old topology under a
+        HIGHER version through the same RPC."""
+        payload = wire.decode(msg.data)
+        manifest = payload["manifest"]
+        version = int(manifest.get("layout_version", 1))
+        if version > self.layout_version:
+            self.layout = manifest
+            self.layout_version = version
+        server = self._zoo.server
+        if server is not None and server.wal is not None:
+            watermark = server.run_serialized(lambda: int(server.wal.seq))
+        else:
+            watermark = -1
+        count("MIGRATION_CUTOVERS")
+        hop(msg.req_id, "migrate_cutover")
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Migrate_Cutover,
+            msg_id=msg.msg_id, req_id=msg.req_id, watermark=watermark,
+            data=wire.encode({"watermark": watermark,
+                              "layout_version": self.layout_version})))
+        log.info("remote: cutover to layout version %d at watermark %d",
+                 version, watermark)
+
     def _ensure_standby_heartbeats(self) -> None:
         """Primary→standby heartbeats: the standby's lease on the primary
         must stay renewed while the WAL idles, or a quiet training lull
@@ -442,6 +534,12 @@ class RemoteServer:
         if msg.type == MsgType.Control_Replicate:
             self._subscribe_standby(msg)
             return
+        if msg.type == MsgType.Control_Migrate:
+            self._subscribe_migrate(msg)
+            return
+        if msg.type == MsgType.Control_Migrate_Cutover:
+            self._migrate_cutover(msg)
+            return
         if msg.type == MsgType.Server_Finish_Train:
             self._zoo.server.send(Message(
                 src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
@@ -451,6 +549,27 @@ class RemoteServer:
             log.error("remote server: unhandled frame type %s", msg.type)
             return
         if self._replayed(msg):
+            return
+        if (self.layout_version > 0 and msg.req_id
+                and 0 <= msg.watermark < self.layout_version):
+            # Stale-layout fence, strictly AFTER the dedup check: a
+            # replayed-but-already-applied Add re-serves its cached ACK
+            # above and never lands here, so a WrongShard refusal
+            # GUARANTEES the request did not apply on this shard — the
+            # router may safely re-issue it under a fresh req_id. Pop the
+            # _INFLIGHT entry _replayed just inserted: this req_id's
+            # story on this shard is over.
+            with self._dedup_lock:
+                if self._dedup.get(msg.req_id) is _INFLIGHT:
+                    del self._dedup[msg.req_id]
+            count("MIGRATION_WRONG_SHARD_REPLIES")
+            hop(msg.req_id, "wrong_shard_refused")
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Reply_WrongShard,
+                table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
+                trace=msg.trace,
+                data=wire.encode({"layout_version": self.layout_version,
+                                  "manifest": self.layout})))
             return
         request = wire.decode(msg.data)
         completion = _NetCompletion(self, msg._conn, msg, compress)
@@ -680,7 +799,7 @@ class RemoteServer:
 
 def control_probe(endpoint: str, request_type: MsgType,
                   reply_type: MsgType, timeout: float = 10.0,
-                  what: str = "probe") -> Any:
+                  what: str = "probe", payload: Any = None) -> Any:
     """Dial ``endpoint``, send one control frame, return the decoded
     reply payload. The shared skeleton under the stats and layout RPCs —
     deliberately NOT a RemoteClient: no worker slot, no lease, no chaos
@@ -711,7 +830,9 @@ def control_probe(endpoint: str, request_type: MsgType,
     threading.Thread(target=pump, daemon=True,
                      name=f"mv-{what}-probe").start()
     try:
-        net.send(Message(src=-1, dst=0, type=request_type, msg_id=msg_id))
+        net.send(Message(src=-1, dst=0, type=request_type, msg_id=msg_id,
+                         data=wire.encode(payload)
+                         if payload is not None else []))
         if not got.wait(timeout):
             raise TimeoutError(f"{what} probe to {endpoint} timed out "
                                f"after {timeout:.1f}s")
@@ -955,7 +1076,7 @@ class RemoteClient:
 
     def _send(self, table_id: int, msg_type: MsgType, request: Any,
               msg_id: int, completion: Optional[Completion],
-              direct: bool = False) -> int:
+              direct: bool = False, watermark: int = -1) -> int:
         """Returns the req_id the request was issued under (0 for
         fire-and-forget posts) so callers a layer up — the shard router —
         can append their own hops to the same trace."""
@@ -975,6 +1096,11 @@ class RemoteClient:
                       table_id=table_id, msg_id=msg_id,
                       req_id=self._next_req_id() if completion is not None
                       else 0,
+                      # a shard router stamps its layout version here so a
+                      # mid-migration donor refuses (Reply_WrongShard)
+                      # instead of applying a possibly-misrouted request;
+                      # plain clients leave -1 (never fenced)
+                      watermark=watermark,
                       trace=self._trace and completion is not None,
                       data=data)
         with self._lock:
@@ -1035,6 +1161,11 @@ class RemoteClient:
                 if msg.type == MsgType.Reply_Error:
                     completion.fail(RuntimeError(
                         f"server-side failure: {wire.decode(msg.data)}"))
+                elif msg.type == MsgType.Reply_WrongShard:
+                    refusal = wire.decode(msg.data)
+                    completion.fail(WrongShardError(
+                        refusal.get("layout_version", 0),
+                        refusal.get("manifest")))
                 elif msg.type == MsgType.Reply_Add:
                     completion.done(None)
                 else:

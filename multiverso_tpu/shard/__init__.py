@@ -22,6 +22,13 @@ keeps its own retry/dedup window, lease table, WAL, and warm standby:
 * :mod:`~multiverso_tpu.shard.group` — :class:`ShardGroup`, a launcher
   that starts one serving process per shard (each with its own WAL dir
   and optional warm standby) and publishes the layout manifest.
+* :mod:`~multiverso_tpu.shard.reshard` — elastic membership:
+  :class:`MigrationCoordinator` executes live key-range **split / merge /
+  move** against a running durable group (fresh joiner processes catch up
+  over the donors' WAL streams, donors fence at a watermark cutover, the
+  layout version bumps and clients re-route in flight — zero acknowledged
+  Adds lost), plus :class:`HotRangeDetector`, which proposes splits from
+  the live per-shard traffic telemetry.
 
 Operator story: ``docs/sharding.md``.
 """
@@ -32,3 +39,6 @@ from multiverso_tpu.shard.partition import (  # noqa: F401
 from multiverso_tpu.shard.router import (  # noqa: F401
     ShardLayout, ShardedClient, fetch_layout)
 from multiverso_tpu.shard.group import ShardGroup  # noqa: F401
+from multiverso_tpu.shard.reshard import (  # noqa: F401
+    HotRangeDetector, MigrationCoordinator, MigrationError, MigrationPlan,
+    plan_merge, plan_move, plan_split)

@@ -19,6 +19,15 @@ standby) and announces a takeover via ``standby<k>.tookover``.
 
 ``--recover`` replays this shard's WAL before serving — the per-shard
 restart-recovery path (docs/fault_tolerance.md §7, per shard).
+
+``--join <spec.json>`` runs a live-migration JOINER (shard/reshard.py):
+build this member's tables at their NEW-layout spans, absorb a quiesced
+range transfer from each donor and tail its WAL (durable/migrate.py),
+report catch-up through a status file, then — once the coordinator's
+cutover file names the per-donor watermarks — drain to them, start
+serving on the pre-assigned port, and announce through a serving file.
+The joiner never serves a single request before every acknowledged donor
+record at or below the cutover watermark has been applied.
 """
 
 from __future__ import annotations
@@ -58,10 +67,100 @@ def _build_tables(mv, spec, shard: int):
     return workers
 
 
+def _run_join(join_path: str) -> int:
+    """Live-migration joiner: catch up on the migrating ranges, wait for
+    the cutover watermarks, then serve (docstring above; the coordinator
+    half lives in shard/reshard.py)."""
+    with open(join_path, "r", encoding="utf-8") as f:
+        join = json.load(f)
+    shard = int(join["shard"])
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.durable import shard_wal_dir
+    from multiverso_tpu.durable.migrate import RangeTailer
+    from multiverso_tpu.runtime.zoo import Zoo
+
+    def status(phase: str, **extra) -> None:
+        extra.update({"phase": phase, "shard": shard})
+        _write_atomic(join["status_path"], json.dumps(extra))
+
+    flags = dict(join.get("flags", {}))
+    flags["ps_role"] = "server"
+    flags.setdefault("metrics_shard", shard)
+    flags.setdefault("metrics_role", "joiner")
+    # fresh WAL lineage: this member's log starts at the absorbed
+    # transfer, not at the donor's history (the donor keeps its own)
+    flags["wal_dir"] = (shard_wal_dir(join["wal_root"], shard)
+                        + join.get("wal_suffix", "-join"))
+    mv.init(**flags)
+    tables = _build_tables(mv, join, shard)
+    by_id = {int(w.table_id): w for w in tables}
+
+    tailers = []
+    try:
+        for donor in join["donors"]:
+            specs = []
+            for s in donor["specs"]:
+                spec = dict(s)
+                spec["server_table"] = by_id[int(s["table_id"])]._server_table
+                specs.append(spec)
+            tailers.append(RangeTailer(donor["endpoint"], specs).start())
+    except (OSError, ConnectionError) as exc:
+        status("failed", error=f"donor subscribe failed: {exc!r}")
+        return 1
+
+    deadline = time.monotonic() + float(join.get("deadline_seconds", 600.0))
+    cutover = None
+    while cutover is None:
+        if time.monotonic() > deadline:
+            status("failed", error="no cutover before the join deadline")
+            return 1
+        for t in tailers:
+            if t.failed.is_set():
+                status("failed", error=t.error)
+                return 1
+        status("catchup",
+               lag=sum(t.lag_records() for t in tailers),
+               applied=sum(t.records_applied for t in tailers),
+               synced=all(t.synced.is_set() for t in tailers))
+        if os.path.exists(join["cutover_path"]):
+            with open(join["cutover_path"], "r", encoding="utf-8") as f:
+                cutover = json.load(f)  # written atomically: never torn
+            break
+        time.sleep(0.1)
+
+    watermarks = cutover.get("watermarks", {})
+    for t in tailers:
+        try:
+            t.wait_watermark(int(watermarks.get(t.donor_endpoint, -1)),
+                             timeout=max(5.0, deadline - time.monotonic()))
+        except (ConnectionError, TimeoutError) as exc:
+            status("failed", error=f"drain failed: {exc!r}")
+            return 1
+    for t in tailers:
+        t.stop()
+
+    manifest = cutover["manifest"]
+    # the port was pre-assigned by the coordinator so the new manifest
+    # could name this endpoint before we serve; bind it now
+    endpoint = mv.serve(f"{join['host']}:{int(join['port'])}")
+    remote = Zoo.instance().remote_server
+    remote.layout = manifest
+    remote.layout_version = int(manifest.get("layout_version", 1))
+    remote.layout_path = join.get("layout_path", "")
+    _write_atomic(join["serving_path"], endpoint)
+    status("serving", endpoint=endpoint)
+    while True:  # killed by the group (SIGTERM) or chaos (SIGKILL)
+        time.sleep(3600)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--spec", required=True)
-    parser.add_argument("--shard", type=int, required=True)
+    parser.add_argument("--spec", default="")
+    parser.add_argument("--shard", type=int, default=-1)
+    parser.add_argument("--join", default="",
+                        help="run as a live-migration joiner from this "
+                             "join-spec file (reshard)")
     parser.add_argument("--standby", action="store_true")
     parser.add_argument("--replica", type=int, default=-1,
                         help="serving read-replica index (>= 0)")
@@ -71,6 +170,10 @@ def main(argv=None) -> int:
     parser.add_argument("--recover", action="store_true")
     parser.add_argument("--port", type=int, default=0)
     args = parser.parse_args(argv)
+    if args.join:
+        return _run_join(args.join)
+    if not args.spec or args.shard < 0:
+        parser.error("--spec and --shard are required (or --join)")
 
     with open(args.spec, "r", encoding="utf-8") as f:
         spec = json.load(f)

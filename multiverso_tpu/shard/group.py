@@ -84,6 +84,10 @@ class ShardGroup:
         self._primaries: List[subprocess.Popen] = []
         self._standbys: List[Optional[subprocess.Popen]] = []
         self._replicas: List[List[subprocess.Popen]] = []
+        # donors retired by a live migration (shard/reshard.py): they keep
+        # running FENCED — serving Reply_WrongShard to stale clients —
+        # until the group stops
+        self._retired_procs: List[subprocess.Popen] = []
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, timeout: float = 240.0) -> "ShardGroup":
@@ -118,16 +122,12 @@ class ShardGroup:
                                   proc=self._replicas[k][i])
                  for i in range(self.num_replicas)]
                 for k in range(self.num_shards)]
-        manifest = {"version": LAYOUT_VERSION,
-                    "num_shards": self.num_shards,
-                    "endpoints": self.endpoints,
-                    "replicas": self.replica_endpoints,
-                    "tables": self.entries}
-        tmp = self.layout_path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, self.layout_path)  # atomic publish
-        self.layout = ShardLayout(manifest)
+        self.publish_manifest({"version": LAYOUT_VERSION,
+                               "num_shards": self.num_shards,
+                               "layout_version": 1,
+                               "endpoints": self.endpoints,
+                               "replicas": self.replica_endpoints,
+                               "tables": self.entries})
         if self.standby:
             for k in range(self.num_shards):
                 self._standbys.append(
@@ -191,6 +191,22 @@ class ShardGroup:
             time.sleep(0.05)
         log.fatal("shard group startup timed out waiting for %s", name)
 
+    def publish_manifest(self, manifest: Dict[str, Any]) -> None:
+        """Atomically publish ``manifest`` as layout.json and adopt it as
+        the group's current view — start() and live migrations
+        (shard/reshard.py) both land here. Members serve the file over
+        Control_Layout; the atomic replace means a bootstrapping client
+        never reads a torn manifest."""
+        tmp = self.layout_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self.layout_path)  # atomic publish
+        self.layout = ShardLayout(manifest)
+        self.endpoints = list(manifest["endpoints"])
+        self.replica_endpoints = [list(r)
+                                  for r in manifest.get("replicas", [])]
+        self.num_shards = int(manifest["num_shards"])
+
     def connect(self, timeout: float = 30.0,
                 read_preference: Optional[str] = None) -> ShardedClient:
         """A router client over this group's layout. ``read_preference``
@@ -228,7 +244,8 @@ class ShardGroup:
     def _all_procs(self) -> List[subprocess.Popen]:
         return (list(self._primaries)
                 + [p for p in self._standbys if p is not None]
-                + [p for fleet in self._replicas for p in fleet])
+                + [p for fleet in self._replicas for p in fleet]
+                + list(self._retired_procs))
 
     def stop(self) -> None:
         for proc in self._all_procs():
@@ -244,6 +261,7 @@ class ShardGroup:
         self._primaries.clear()
         self._standbys.clear()
         self._replicas.clear()
+        self._retired_procs.clear()
 
     def __enter__(self) -> "ShardGroup":
         return self

@@ -42,6 +42,11 @@ from multiverso_tpu.updaters import AddOption, GetOption
 
 LAYOUT_VERSION = 1
 
+# how many times one logical request may chase the layout before its
+# failure surfaces to the caller (each attempt re-fetches/installs the
+# newest layout first, so >1 migration completing mid-request is covered)
+_MAX_REROUTES = 3
+
 
 class ShardLayout:
     """The shard group's layout manifest — who serves what, where.
@@ -50,18 +55,27 @@ class ShardLayout:
     ShardGroup`, fetched by clients via the ``Control_Layout`` RPC)::
 
         {"version": 1, "num_shards": N,
+         "layout_version": 1,                       # monotonic; bumped by
+                                                    # every live migration
          "endpoints": ["host:port", ...],           # one per shard
          "replicas": [["host:port", ...], ...],     # optional: per-shard
                                                     # read-replica fleets
          "tables": [{"table_id": 0, "kind": "matrix",
                      "params": {...global ctor args...},
                      "partitioner": {"kind": "range", ...}}, ...]}
+
+    ``version`` is the manifest SCHEMA version (a format contract);
+    ``layout_version`` is the TOPOLOGY generation — it only moves
+    forward, each split/merge/move bumps it, and routers stamp it on
+    every sharded request so a mid-migration server can refuse stale
+    routing with ``Reply_WrongShard`` (docs/sharding.md).
     """
 
     def __init__(self, manifest: Dict[str, Any]) -> None:
         if int(manifest.get("version", 0)) != LAYOUT_VERSION:
             log.fatal("shard layout version %r unsupported (want %d)",
                       manifest.get("version"), LAYOUT_VERSION)
+        self.layout_version = int(manifest.get("layout_version", 1))
         self.manifest = manifest
         self.endpoints: List[str] = list(manifest["endpoints"])
         self.num_shards = int(manifest.get("num_shards",
@@ -106,12 +120,32 @@ def fetch_layout(endpoint: str, timeout: float = 10.0) -> ShardLayout:
     """One-shot layout RPC: any member of a shard group answers with the
     full manifest, so clients bootstrap from a single known endpoint (the
     reference's Controller broadcast, pull-shaped). Like the stats probe,
-    this takes no worker slot and no lease."""
+    this takes no worker slot and no lease.
+
+    Connection-level failures (refused, reset, probe timeout) retry with
+    exponential backoff inside ``timeout``: a client racing a group's
+    startup — or a migration's member churn — should wait out the bind
+    race, not fail on the first probe. A server-side REFUSAL (not a
+    shard-group member) still raises immediately."""
     from multiverso_tpu.runtime.remote import control_probe
-    payload = control_probe(endpoint, MsgType.Control_Layout,
-                            MsgType.Control_Reply_Layout, timeout=timeout,
-                            what="layout")
-    return ShardLayout(payload)
+    deadline = time.monotonic() + timeout
+    delay = 0.05
+    while True:
+        remaining = deadline - time.monotonic()
+        try:
+            payload = control_probe(endpoint, MsgType.Control_Layout,
+                                    MsgType.Control_Reply_Layout,
+                                    timeout=max(0.2, remaining),
+                                    what="layout")
+            return ShardLayout(payload)
+        except OSError as exc:  # ConnectionError/TimeoutError included
+            if time.monotonic() + delay >= deadline:
+                raise
+            count("LAYOUT_FETCH_RETRIES")
+            log.debug("fetch_layout(%s): %r — retrying in %.2fs",
+                      endpoint, exc, delay)
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
 
 
 # -- split/merge (pure; the bit-identical contract lives here) ---------------
@@ -385,25 +419,65 @@ def _empty_reply(kind: str, msg_type: MsgType, request: Any,
     return np.zeros(0, dtype)
 
 
+def globalize_add(kind: str, sub: Any, part, shard: int) -> Any:
+    """Map one shard-local Add sub-request back to GLOBAL coordinates.
+
+    When a live migration fences a shard mid-fan-out, only SOME parts of
+    an Add are refused with ``Reply_WrongShard``; the applied parts must
+    not be re-sent (Adds are not idempotent across a layout change — the
+    dedup window does not migrate). The refused part re-enters the router
+    as a fresh global request and re-splits under the NEW layout. This is
+    the inverse of the split functions, pure so tests can assert
+    split → globalize → re-split is lossless. Only range-partitioned
+    array/matrix tables can migrate (reshard.plan_* refuse the rest), so
+    only their sub-request shapes are invertible here.
+    """
+    if kind == "matrix":
+        local, vals, option = sub
+        lo, hi = part.span(shard)
+        if local is None:
+            # whole-span Add: the shard's slice of a full-table payload
+            rows = np.asarray(vals).reshape(hi - lo, -1)
+            return np.arange(lo, hi, dtype=np.int32), rows, option
+        ids = part.to_global(np.asarray(local).reshape(-1), shard)
+        return ids.astype(np.int32, copy=False), np.asarray(vals), option
+    if kind == "array":
+        delta, option = sub
+        lo, hi = part.span(shard)
+        flat = np.asarray(delta).reshape(-1)
+        out = np.zeros(part.total, flat.dtype)
+        out[lo:hi] = flat
+        return out, option
+    log.fatal("router: cannot globalize a %r Add part (only migratable "
+              "kinds are re-routed)", kind)
+
+
 # -- fan-out completion ------------------------------------------------------
 
 
 class _MergeCompletion:
     """Counts down the per-shard partial replies; on the last one, merges
-    and settles the caller's completion. The first failed part fails the
-    whole request (the per-shard RemoteClient already burned its own
-    retry/reconnect budget before reporting failure)."""
+    and settles the caller's completion. A failed part is first offered to
+    the router's migration-retry hook (``retry``): the hook may re-issue
+    the part under a refreshed layout ("reissued" — the merge stays armed
+    and the hook settles the part later) or take over the whole request
+    ("superseded" — the merge disarms without failing; the hook completes
+    the caller's completion itself). Unhandled failures fail the whole
+    request (the per-shard RemoteClient already burned its own retry/
+    reconnect budget before reporting failure)."""
 
     __slots__ = ("_completion", "_merge", "_results", "_left", "_failed",
-                 "_lock")
+                 "_lock", "_retry")
 
-    def __init__(self, completion, n_parts: int, merge_fn) -> None:
+    def __init__(self, completion, n_parts: int, merge_fn,
+                 retry=None) -> None:
         self._completion = completion
         self._merge = merge_fn
         self._results: List[Any] = [None] * n_parts
         self._left = n_parts
         self._failed = False
         self._lock = threading.Lock()
+        self._retry = retry
 
     def part(self, idx: int, shard: int) -> "_PartCompletion":
         return _PartCompletion(self, idx, shard)
@@ -421,7 +495,27 @@ class _MergeCompletion:
             # waiter, not kill the per-shard pump thread delivering the reply
             self._completion.fail(exc)
 
-    def _part_fail(self, idx: int, error: BaseException) -> None:
+    def _part_fail(self, idx: int, shard: int,
+                   error: BaseException) -> None:
+        if self._retry is not None:
+            with self._lock:
+                if self._failed:
+                    return
+            verdict = None
+            try:
+                verdict = self._retry(self, idx, shard, error)
+            except Exception as exc:  # noqa: BLE001 — a hook bug fails the
+                # request, never the pump thread delivering the refusal
+                error = exc
+            if verdict == "reissued":
+                return
+            if verdict == "superseded":
+                with self._lock:
+                    self._failed = True
+                return
+        self._force_fail(error)
+
+    def _force_fail(self, error: BaseException) -> None:
         with self._lock:
             if self._failed:
                 return
@@ -450,7 +544,7 @@ class _PartCompletion:
     def fail(self, error: BaseException) -> None:
         observe(f"ROUTER_SHARD{self._shard}_SECONDS",
                 time.monotonic() - self._t0)
-        self._parent._part_fail(self._idx, error)
+        self._parent._part_fail(self._idx, self._shard, error)
 
 
 class _ShardChannel:
@@ -489,11 +583,21 @@ class ShardedClient:
         self.layout = (layout if isinstance(layout, ShardLayout)
                        else ShardLayout(layout))
         from multiverso_tpu.runtime.remote import RemoteClient
+        self._timeout = timeout
+        self._read_pref = read_preference
         # wire_quant_bits routes THROUGH the shard router: residuals are
-        # kept as per-shard slices keyed by the layout's partitioner and
-        # sub-requests compress after the split (see _table_efs/_route)
-        self._efs: Dict[int, Optional[List[Any]]] = {}
+        # kept as per-shard slices keyed by (table, layout generation) —
+        # a migration re-partitions the table, so the slices rebuild
+        # (residual history resets; quantization is lossy anyway)
+        self._efs: Dict[Tuple[int, int], Optional[List[Any]]] = {}
         self._ef_lock = threading.Lock()
+        # _state_lock guards the (layout, clients, shard_wids) triple so a
+        # routing attempt reads one consistent snapshot; _refresh_lock
+        # serializes whole refresh operations (which dial sockets and can
+        # take seconds) without blocking routers on the hot path
+        self._state_lock = threading.Lock()
+        self._refresh_lock = threading.Lock()
+        self._retired: List[RemoteClient] = []
         self._clients: List[RemoteClient] = []
         try:
             for shard, endpoint in enumerate(self.layout.endpoints):
@@ -527,39 +631,55 @@ class ShardedClient:
             self.directory.append(base)
 
     # -- routing -------------------------------------------------------------
-    def _rewrite_option(self, shard: int, option: Any) -> Any:
+    def _rewrite_option(self, wids: List[int], shard: int,
+                        option: Any) -> Any:
         """Default-stamped envelopes (worker_id == this router's
         representative id) are re-stamped with the shard-local worker id;
-        explicit/admin envelopes pass through untouched."""
+        explicit/admin envelopes pass through untouched. ``wids`` is the
+        attempt's shard-worker-id snapshot (a concurrent layout refresh
+        must not shift indices mid-split)."""
         if (isinstance(option, (AddOption, GetOption))
                 and option.worker_id == self.worker_id
-                and self._shard_wids[shard] != self.worker_id):
-            return dataclasses.replace(option,
-                                       worker_id=self._shard_wids[shard])
+                and wids[shard] != self.worker_id):
+            return dataclasses.replace(option, worker_id=wids[shard])
         return option
 
-    def _table_efs(self, table_id: int, entry: Dict[str, Any],
-                   part) -> Optional[List[Any]]:
+    def _table_efs(self, table_id: int, entry: Dict[str, Any], part,
+                   version: int) -> Optional[List[Any]]:
         """Lazily built per-shard residual slices (full-table float32 —
-        only allocate for tables that actually Add)."""
+        only allocate for tables that actually Add). Keyed by layout
+        generation: a migration changes the partitioner, so stale slices
+        must never compress a new-generation split."""
+        key = (int(table_id), int(version))
         with self._ef_lock:
-            if table_id not in self._efs:
-                self._efs[table_id] = make_shard_error_feedback(
+            if key not in self._efs:
+                self._efs[key] = make_shard_error_feedback(
                     entry["kind"], entry["params"], part,
                     int(config.get_flag("wire_quant_bits")))
-            return self._efs[table_id]
+            return self._efs[key]
 
     def _route(self, table_id: int, msg_type: MsgType, request: Any,
                completion) -> None:
-        entry = self.layout.entry(table_id)
-        part = self.layout.partitioner(table_id)
-        efs = (self._table_efs(table_id, entry, part)
+        self._route_attempt(table_id, msg_type, request, completion, 0)
+
+    def _route_attempt(self, table_id: int, msg_type: MsgType, request: Any,
+                       completion, attempt: int) -> None:
+        with self._state_lock:  # one consistent snapshot per attempt
+            layout = self.layout
+            clients = self._clients
+            wids = self._shard_wids
+        version = layout.layout_version
+        entry = layout.entry(table_id)
+        part = layout.partitioner(table_id)
+        efs = (self._table_efs(table_id, entry, part, version)
                if msg_type == MsgType.Request_Add else None)
         if efs is not None:
             request = dedup_add_ids(entry["kind"], request)
+        rewrite = lambda s, o: self._rewrite_option(wids, s, o)  # noqa: E731
         parts, merge = split_request(entry["kind"], part, msg_type, request,
                                      entry["params"],
-                                     rewrite_option=self._rewrite_option)
+                                     rewrite_option=rewrite)
+        plain_parts = parts  # pre-quantization, for WrongShard re-issue
         if efs is not None and parts:
             # residual state mutates per compress: serialize against
             # concurrent Adds to the same table
@@ -567,22 +687,194 @@ class ShardedClient:
                 parts = quantize_split_parts(entry["kind"], efs, parts)
         if completion is None:
             for shard, sub in parts:
-                self._clients[shard]._send(table_id, msg_type, sub,
-                                           next_msg_id(), None)
+                clients[shard]._send(table_id, msg_type, sub,
+                                     next_msg_id(), None,
+                                     watermark=version)
             return
         if not parts:
             completion.done(_empty_reply(entry["kind"], msg_type, request,
                                          entry["params"]))
             return
         count("ROUTER_FANOUT", len(parts))
-        mc = _MergeCompletion(completion, len(parts), merge)
+        retry = None
+        if attempt < _MAX_REROUTES:
+            retry = self._migration_retry(table_id, msg_type, request,
+                                          completion, attempt, entry, part,
+                                          wids, plain_parts)
+        mc = _MergeCompletion(completion, len(parts), merge, retry=retry)
         for idx, (shard, sub) in enumerate(parts):
-            rid = self._clients[shard]._send(table_id, msg_type, sub,
-                                             next_msg_id(),
-                                             mc.part(idx, shard))
+            rid = clients[shard]._send(table_id, msg_type, sub,
+                                       next_msg_id(),
+                                       mc.part(idx, shard),
+                                       watermark=version)
             # _send returns the per-shard span id (0 untraced): tag which
             # shard this leg targeted so a stitched trace shows the fan
             hop(rid, f"router_shard{shard}")
+
+    def _migration_retry(self, table_id: int, msg_type: MsgType,
+                         request: Any, completion, attempt: int,
+                         entry: Dict[str, Any], part, wids: List[int],
+                         plain_parts: List[Tuple[int, Any]]):
+        """Build the _MergeCompletion retry hook for one fan-out attempt.
+
+        Re-route contract (docs/sharding.md): a ``Reply_WrongShard``
+        PROVES the part was not applied (the server consults its dedup
+        window before the layout fence), so an Add re-issues exactly the
+        refused parts — globalized back through the attempt's partitioner
+        and re-split under the refreshed layout — while the applied parts
+        stand; re-sending those would double-apply. A Get is idempotent,
+        so any refusal or connection loss simply aborts the merge and
+        re-runs the WHOLE request against the new layout. Refresh + dial
+        happen on a short-lived daemon thread, never on the per-shard
+        pump thread that delivered the refusal.
+        """
+        from multiverso_tpu.runtime.remote import WrongShardError
+
+        def handler(mc, idx, shard, error):
+            wrong = isinstance(error, WrongShardError)
+            if not wrong and not (msg_type == MsgType.Request_Get
+                                  and isinstance(error, ConnectionError)):
+                return None
+            manifest = error.manifest if wrong else None
+            count("ROUTER_REROUTES")
+            if msg_type == MsgType.Request_Get:
+                def rerun():
+                    try:
+                        self.refresh_layout(manifest)
+                        self._route_attempt(table_id, msg_type, request,
+                                            completion, attempt + 1)
+                    except BaseException as exc:  # noqa: BLE001
+                        completion.fail(exc)
+                threading.Thread(target=rerun, daemon=True,
+                                 name="mv-router-reroute").start()
+                return "superseded"
+            sub = plain_parts[idx][1]
+
+            class _Relay:  # settles the original merge slot
+                def done(_self, result):  # noqa: N805
+                    mc._part_done(idx, None)
+
+                def fail(_self, err):  # noqa: N805
+                    mc._force_fail(err)
+
+            def rerun():
+                try:
+                    self.refresh_layout(manifest)
+                    g = globalize_add(entry["kind"], sub, part, shard)
+                    # undo the OLD shard's option re-stamp so the next
+                    # attempt re-stamps for whichever shard now owns it
+                    opt = g[-1]
+                    if (isinstance(opt, (AddOption, GetOption))
+                            and opt.worker_id == wids[shard]):
+                        opt = dataclasses.replace(
+                            opt, worker_id=self.worker_id)
+                    self._route_attempt(table_id, msg_type,
+                                        g[:-1] + (opt,), _Relay(),
+                                        attempt + 1)
+                except BaseException as exc:  # noqa: BLE001
+                    mc._force_fail(exc)
+            threading.Thread(target=rerun, daemon=True,
+                             name="mv-router-reroute").start()
+            return "reissued"
+        return handler
+
+    # -- layout refresh ------------------------------------------------------
+    def refresh_layout(self, manifest: Optional[Any] = None,
+                       dial_timeout: Optional[float] = None) -> bool:
+        """Adopt a newer layout; returns True if one was installed.
+
+        ``manifest`` usually rides in on a ``Reply_WrongShard`` refusal;
+        when None (connection loss — no refusal to learn from), the
+        current members are polled for whatever layout is published.
+        Per-shard clients for endpoints still in the layout are REUSED
+        (their worker slots, updater state and dedup windows survive);
+        clients for endpoints that left are retired — kept open, since
+        their pumps may still be delivering refusals for in-flight
+        requests — and closed at :meth:`close`.
+        """
+        with self._refresh_lock:
+            fresh = None
+            if manifest is not None:
+                cand = (manifest if isinstance(manifest, ShardLayout)
+                        else ShardLayout(manifest))
+                if cand.layout_version > self.layout.layout_version:
+                    fresh = cand
+            else:
+                for ep in list(self.layout.endpoints):
+                    try:
+                        cand = fetch_layout(ep, timeout=2.0)
+                    except (OSError, RuntimeError):
+                        continue
+                    if cand.layout_version > self.layout.layout_version:
+                        fresh = cand
+                    break
+            if fresh is None:
+                return False
+            self._install_layout(fresh, dial_timeout)
+            return True
+
+    def _install_layout(self, fresh: ShardLayout,
+                        dial_timeout: Optional[float]) -> None:
+        """Swap in ``fresh`` (caller holds ``_refresh_lock``). New
+        endpoints dial with retry/backoff: a WrongShard refusal races the
+        migration's recipient binding its port, so first dials may be
+        refused for a moment."""
+        from multiverso_tpu.runtime.remote import RemoteClient
+        current = dict(zip(self.layout.endpoints, self._clients))
+        deadline = time.monotonic() + float(
+            dial_timeout if dial_timeout is not None
+            else config.get_flag("reconnect_deadline_seconds"))
+        clients: List[Any] = []
+        fresh_clients: List[Any] = []
+        try:
+            for shard, ep in enumerate(fresh.endpoints):
+                client = current.pop(ep, None)
+                if client is None:
+                    delay = 0.05
+                    while True:
+                        try:
+                            client = RemoteClient(
+                                ep, timeout=self._timeout,
+                                read_endpoints=fresh.replicas[shard],
+                                read_preference=self._read_pref)
+                            break
+                        except OSError:
+                            if time.monotonic() + delay >= deadline:
+                                raise
+                            time.sleep(delay)
+                            delay = min(delay * 2, 1.0)
+                    fresh_clients.append(client)
+                clients.append(client)
+        except BaseException:
+            for c in fresh_clients:
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
+        with self._state_lock:
+            self._retired.extend(current.values())
+            self._clients = clients
+            self.layout = fresh
+            self.num_shards = fresh.num_shards
+            # self.worker_id stays STABLE: it is the sentinel proxies
+            # stamp into default option envelopes (_rewrite_option)
+            self._shard_wids = [c.worker_id for c in clients]
+        with self._ef_lock:
+            self._efs.clear()
+        # flush the read tier: rows that changed owner must not serve
+        # from a replica snapshot keyed to the old layout
+        for entry in fresh.tables:
+            for c in clients:
+                rr = getattr(c, "_read_router", None)
+                if rr is not None:
+                    try:
+                        rr.note_local_write(int(entry["table_id"]))
+                    except Exception:  # noqa: BLE001
+                        pass
+        count("ROUTER_LAYOUT_REFRESHES")
+        log.info("router: adopted layout v%d (%d shards)",
+                 fresh.layout_version, fresh.num_shards)
 
     def _post_all(self, table_id: int, msg_type: MsgType) -> None:
         """Fire-and-forget control posts (finish_train) fan to every
@@ -622,7 +914,7 @@ class ShardedClient:
 
     # -- lifecycle -------------------------------------------------------------
     def close(self) -> None:
-        for client in self._clients:
+        for client in list(self._clients) + list(self._retired):
             try:
                 client.close()
             except Exception:  # noqa: BLE001 — best-effort fan-out close

@@ -371,6 +371,25 @@ class ArrayServer(ServerTable):
             full[:, : self.size] = got
             self.states[name] = jax.device_put(full, s_shard)
 
+    # -- live migration (shard/reshard.py) ---------------------------------
+    def extract_range(self, lo: int, hi: int):
+        """Raw values of shard-local elements [lo, hi) — the migration
+        transfer unit (updater state excluded; documented reset)."""
+        return self._host_read(self.data)[lo:hi]
+
+    def absorb_range(self, start: int, values) -> None:
+        """Install raw values at [start, start+len), bypassing updaters —
+        the recipient side of extract_range."""
+        values = np.asarray(values, dtype=self.dtype).reshape(-1)
+        n = values.size
+        if start < 0 or start + n > self.size:
+            log.fatal("absorb_range [%d, %d) outside [0, %d)",
+                      start, start + n, self.size)
+        padded = np.array(self._host_read(self.data))
+        padded[start:start + n] = values
+        self.data = jax.device_put(
+            padded, mesh_lib.table_sharding(self.mesh, ndim=1))
+
 
 class ArrayWorker(WorkerTable):
     """Client proxy for a 1-D dense table (whole-table Get/Add)."""

@@ -266,3 +266,18 @@ class ServerTable:
 
     def load(self, stream) -> None:
         raise NotImplementedError
+
+    # -- live migration hooks (shard/reshard.py) ----------------------------
+    # Raw-value slice transfer for key-range migration: extract hands the
+    # coordinator the CURRENT values of a shard-local id range (no updater
+    # involvement, mirrors store()); absorb installs values at a range on
+    # the recipient, bypassing updaters entirely — a migrated value is
+    # state, not a gradient. Only range-partitionable kinds implement
+    # these; the migration planner refuses the rest before ever calling.
+    def extract_range(self, lo: int, hi: int) -> Any:
+        log.fatal("live migration is unsupported for %s (no extract_range)",
+                  type(self).__name__)
+
+    def absorb_range(self, start: int, values: Any) -> None:
+        log.fatal("live migration is unsupported for %s (no absorb_range)",
+                  type(self).__name__)

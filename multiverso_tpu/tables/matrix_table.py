@@ -515,6 +515,30 @@ class MatrixServer(ServerTable):
             with self._std_lock:
                 self._up_to_date[:, :] = False
 
+    # -- live migration (shard/reshard.py) ---------------------------------
+    def extract_range(self, lo: int, hi: int):
+        """Raw values of shard-local rows [lo, hi) — the migration
+        transfer unit. Updater state deliberately excluded (documented
+        reset on migration, like a v1 checkpoint restore)."""
+        return self._host_read(self.data)[lo:hi, : self.num_col]
+
+    def absorb_range(self, start: int, values) -> None:
+        """Install raw rows at [start, start+len) — the recipient side of
+        extract_range. Bypasses updaters: migrated values are state, not
+        gradients (an updater would rescale them)."""
+        values = np.asarray(values, dtype=self.dtype)
+        n = values.shape[0]
+        if start < 0 or start + n > self.num_row:
+            log.fatal("absorb_range [%d, %d) outside [0, %d)",
+                      start, start + n, self.num_row)
+        padded = np.array(self._host_read(self.data))
+        padded[start:start + n, : self.num_col] = values
+        self.data = jax.device_put(
+            padded, mesh_lib.table_sharding(self.mesh, ndim=2, shard_dim=0))
+        if self.is_sparse:
+            with self._std_lock:
+                self._up_to_date[:, start:start + n] = False
+
 
 class MatrixWorker(WorkerTable):
     """Client proxy for a 2-D table: whole or row-subset Get/Add; in sparse

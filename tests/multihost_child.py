@@ -24,8 +24,8 @@ def main() -> int:
     scenario = sys.argv[5]
 
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(f"127.0.0.1:{coord_port}", world, rank)
+    from multiverso_tpu.runtime.multihost import init_distributed_cpu
+    init_distributed_cpu(f"127.0.0.1:{coord_port}", world, rank)
 
     import numpy as np
     import multiverso_tpu as mv
