@@ -26,6 +26,10 @@
 #                       docs/sharding.md §8)
 #   make metrics-smoke  short remote-training session; assert the metrics
 #                       JSONL parses and key latency histograms are non-empty
+#   make profile-smoke  sampling profiler + critical-path attribution
+#                       end-to-end: wait sites show up, Control_Profile
+#                       answers, attribution table is non-empty
+#                       (docs/observability.md §13)
 #   make dryrun         multi-chip sharding compile+execute check (CPU mesh)
 #   make bench          the headline JSON line (real TPU when available)
 #   make apply-bench    apply-path micro-bench only: fused vs per-message
@@ -38,9 +42,9 @@ CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
-	native test dryrun bench apply-bench read-bench clean
+	profile-smoke native test dryrun bench apply-bench read-bench clean
 
-check: lint native test dryrun bench
+check: lint native test dryrun profile-smoke bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -64,6 +68,9 @@ chaos:
 
 metrics-smoke:
 	$(CPU_ENV) $(PYTHON) tests/metrics_smoke.py
+
+profile-smoke:
+	$(CPU_ENV) $(PYTHON) tests/profile_smoke.py
 
 failover:
 	$(CPU_ENV) CHAOS_SEED=$(CHAOS_SEED) $(PYTHON) -m pytest \

@@ -42,6 +42,95 @@ def load_metrics(path):
     return _load(path)
 
 
+def _env_fingerprint():
+    """Environment identity stamped into every bench JSON (the ``env``
+    key): results measured in different environments are not comparable
+    — the r05↔r06 incomparability used to live only in a prose note and
+    silently produced bogus regression verdicts. ``--compare`` warns (or
+    refuses under ``--require-same-env``) when fingerprints differ."""
+    import os
+    import socket
+    fp = {"hostname": socket.gethostname(),
+          "nproc": os.cpu_count() or 0}
+    try:
+        import jax
+        devices = jax.devices()
+        fp["jax_backend"] = jax.default_backend()
+        fp["device_kind"] = devices[0].device_kind if devices else ""
+        fp["device_count"] = len(devices)
+    except Exception as exc:  # fingerprinting must never sink a bench
+        fp["jax_backend"] = "unavailable:" + repr(exc)[:80]
+        fp["device_kind"] = ""
+        fp["device_count"] = 0
+    return fp
+
+
+# --attribute mode: set from __main__, consumed by the leg wrappers
+_ATTRIBUTE = False
+
+
+def _collect_leg_attribution(label, tables):
+    """``--attribute``: decompose the traces the leg just left in the
+    local store into a critical-path table (obs/critpath.py), then clear
+    the store so the next leg attributes only its own traffic."""
+    try:
+        from multiverso_tpu.obs.collector import TraceCollector
+        from multiverso_tpu.obs.critpath import attribute
+        from multiverso_tpu.obs.trace import TRACES
+        collector = TraceCollector([], include_local=True)
+        collector.collect()
+        spans = collector.stitch()
+        TRACES.reset()
+        report = attribute(spans)
+        if report.rows:
+            tables[label] = report.to_dict()
+    except Exception as exc:  # attribution must never sink the bench
+        tables[label] = {"error": repr(exc)[:200]}
+
+
+def bench_profile_overhead(rows=100_000, cols=128, passes=20):
+    """Continuous-profiler overhead A/B on the in-process dense pass:
+    the same donated whole-table pass timed with the sampler off, then
+    with a continuous ``SamplingProfiler`` running at the default
+    ``profile_hz`` and feeding PROFILE_* gauges. The acceptance bar is
+    ``profile_overhead_pct`` <= 3 (min-of-3 both legs, so shared-host
+    noise has to hit every rep to fake an overhead)."""
+    import jax
+    import jax.numpy as jnp
+
+    from multiverso_tpu.obs.profiler import SamplingProfiler
+
+    dense = jax.jit(lambda d: d + 1.0, donate_argnums=(0,))
+    d = dense(jnp.zeros((rows, cols), jnp.float32))
+    _fetch(d[0, :1])
+
+    def leg():
+        nonlocal d
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(passes):
+                d = dense(d)
+            _fetch(d[0, :1])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    base = leg()
+    profiler = SamplingProfiler(emit_metrics=True)
+    profiler.start()
+    try:
+        profiled = leg()
+    finally:
+        profiler.stop()
+    overhead_pct = (profiled - base) / base * 100.0 if base > 0 else 0.0
+    return {
+        "profile_overhead_pct": round(overhead_pct, 2),
+        "profile_dense_base_seconds": round(base, 6),
+        "profile_dense_profiled_seconds": round(profiled, 6),
+        "profile_samples": profiler.samples,
+    }
+
+
 def _tpu_reps(tpu_reps, cpu_reps, sleep_s=1.5):
     """Repeat counter for burst-robust sections: more reps on the shared
     tunneled TPU, with a spacing sleep between them so seconds-scale load
@@ -1285,6 +1374,7 @@ def wait_for_quiet(threshold_gbps=None, max_wait_s=None):
 
 
 def main():
+    attribution_tables = {}
     pre_probe = wait_for_quiet()
     (words_per_sec, final_loss), w2v_probe = run_gated(bench_word2vec)
     ps, ps_probe = run_gated(bench_ps_word2vec)
@@ -1299,19 +1389,34 @@ def main():
         apply_bench = bench_apply_path()
     except Exception as exc:  # the serving leg must not sink the TPU figures
         apply_bench = {"apply_bench_error": repr(exc)[:300]}
+    if _ATTRIBUTE:
+        # the legs above ran in-process/loopback, so the local trace
+        # store holds their request hops; per-leg collection resets the
+        # store so each table attributes only its own traffic
+        _collect_leg_attribution("apply_path", attribution_tables)
     try:
         mh = bench_multihost_ps()
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         mh = {"multihost_error": repr(exc)[:300]}
+    if _ATTRIBUTE:
+        _collect_leg_attribution("multihost", attribution_tables)
     import os
     try:
         sharded = bench_sharded(int(os.environ.get("MV_BENCH_SHARDS", "2")))
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         sharded = {"sharded_error": repr(exc)[:300]}
+    if _ATTRIBUTE:
+        _collect_leg_attribution("sharded", attribution_tables)
     try:
         read = bench_read()
     except Exception as exc:  # the spawn leg must not sink the TPU figures
         read = {"read_bench_error": repr(exc)[:300]}
+    if _ATTRIBUTE:
+        _collect_leg_attribution("read", attribution_tables)
+    try:
+        prof_overhead = bench_profile_overhead()
+    except Exception as exc:  # the profiler leg must not sink the figures
+        prof_overhead = {"profile_overhead_error": repr(exc)[:300]}
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
@@ -1335,7 +1440,11 @@ def main():
         **mh,
         **sharded,
         **read,
+        **prof_overhead,
+        "env": _env_fingerprint(),
     }
+    if attribution_tables:
+        result["attribution"] = attribution_tables
     if pre_probe is not None:
         # shared-chip load probes (quiet ~760+ GB/s): the pre-run value
         # plus one per gated section — a low value labels the figure as
@@ -1393,11 +1502,43 @@ def _load_bench_json(path):
             if isinstance(v, (int, float)) and not isinstance(v, bool)}
 
 
+def _load_bench_env(path):
+    """The ``env`` fingerprint of a bench result file, or None for
+    pre-fingerprint files (they predate the stamp and cannot differ)."""
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    env = data.get("env")
+    return env if isinstance(env, dict) else None
+
+
+def _env_mismatch(env_a, env_b):
+    """Fingerprint fields that differ between two bench envs; empty when
+    they match or when either file predates fingerprinting."""
+    if not env_a or not env_b:
+        return []
+    return sorted(k for k in set(env_a) | set(env_b)
+                  if env_a.get(k) != env_b.get(k))
+
+
 def bench_compare(path_a, path_b, threshold=0.10):
     """Compare two bench result files (A = baseline, B = candidate):
     any throughput down or latency up by more than ``threshold``
     (fractional) is a regression. Prints a verdict table; returns the
-    list of regressed metric names (empty = pass)."""
+    list of regressed metric names (empty = pass). Differing environment
+    fingerprints print a loud warning first — the verdicts below it are
+    then cross-environment noise, not regressions."""
+    mismatch = _env_mismatch(_load_bench_env(path_a),
+                             _load_bench_env(path_b))
+    if mismatch:
+        env_a, env_b = _load_bench_env(path_a), _load_bench_env(path_b)
+        print("WARNING: environment fingerprints differ — the verdicts "
+              "below compare different environments and are NOT "
+              "regression evidence:")
+        for field in mismatch:
+            print(f"  {field}: A={env_a.get(field)!r}  "
+                  f"B={env_b.get(field)!r}")
     a, b = _load_bench_json(path_a), _load_bench_json(path_b)
     rows, regressions = [], []
     for key in sorted(set(a) & set(b)):
@@ -1431,14 +1572,25 @@ def bench_compare(path_a, path_b, threshold=0.10):
 
 
 def _run_compare(argv):
-    """``--compare A.json B.json [--threshold 0.1]`` -> exit status."""
+    """``--compare A.json B.json [--threshold 0.1]
+    [--require-same-env]`` -> exit status. With ``--require-same-env``
+    a fingerprint mismatch refuses the comparison (exit 2) instead of
+    producing cross-environment verdicts under a warning."""
     import sys
     i = argv.index("--compare")
     paths = [a for a in argv[i + 1:] if not a.startswith("--")][:2]
     if len(paths) != 2:
         print("usage: bench.py --compare A.json B.json "
-              "[--threshold 0.1]", file=sys.stderr)
+              "[--threshold 0.1] [--require-same-env]", file=sys.stderr)
         return 2
+    if "--require-same-env" in argv:
+        mismatch = _env_mismatch(_load_bench_env(paths[0]),
+                                 _load_bench_env(paths[1]))
+        if mismatch:
+            print("refusing to compare: environment fingerprints differ "
+                  f"({', '.join(mismatch)}); drop --require-same-env to "
+                  "compare anyway under a warning", file=sys.stderr)
+            return 2
     threshold = 0.10
     for j, arg in enumerate(argv):
         if arg == "--threshold" and j + 1 < len(argv):
@@ -1450,6 +1602,19 @@ def _run_compare(argv):
 
 if __name__ == "__main__":
     import sys
+    # --attribute: attach critical-path tables (obs/critpath.py) to the
+    # printed JSON — per serving leg in the full run, one table in the
+    # single-leg modes
+    _ATTRIBUTE = "--attribute" in sys.argv[1:]
+
+    def _single_leg_result(result):
+        if _ATTRIBUTE:
+            tables = {}
+            _collect_leg_attribution(result["metric"], tables)
+            result["attribution"] = tables
+        result["env"] = _env_fingerprint()
+        return result
+
     # spawn_lockstep_world child argv: rank world coord ctl scenario
     if len(sys.argv) >= 6 and sys.argv[5] == "_mh_child":
         _multihost_child(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3],
@@ -1459,13 +1624,14 @@ if __name__ == "__main__":
     elif "--apply-bench" in sys.argv[1:]:
         # apply-path micro-bench only (`make apply-bench`): fused vs
         # per-message A/B, producer sweep, shm vs TCP RTT
-        print(json.dumps({"metric": "served_add_gbps",
-                          **bench_apply_path()}))
+        print(json.dumps(_single_leg_result(
+            {"metric": "served_add_gbps", **bench_apply_path()})))
     elif "--read-bench" in sys.argv[1:]:
         # read-path A/B only (`make read-bench`): Zipf hot-key Gets,
         # primary vs replica vs replica+cache vs hedged
-        print(json.dumps({"metric": "read_gets_per_sec_replica_cache",
-                          **bench_read()}))
+        print(json.dumps(_single_leg_result(
+            {"metric": "read_gets_per_sec_replica_cache",
+             **bench_read()})))
     elif "--compare" in sys.argv[1:]:
         # regression diff of two result files (CI runs non-blocking)
         sys.exit(_run_compare(sys.argv))
@@ -1474,7 +1640,8 @@ if __name__ == "__main__":
         if shards is not None:
             # sharded-tier scaling run only: spin a local ShardGroup and
             # report aggregate + per-shard throughput vs single-server
-            print(json.dumps({"metric": "sharded_row_adds_per_sec",
-                              **bench_sharded(shards)}))
+            print(json.dumps(_single_leg_result(
+                {"metric": "sharded_row_adds_per_sec",
+                 **bench_sharded(shards)})))
         else:
             main()

@@ -90,6 +90,12 @@ def _start_observability() -> None:
     if float(get_flag("timeseries_interval_seconds")) > 0:
         from multiverso_tpu.obs.timeseries import TIMESERIES
         TIMESERIES.start()
+    if bool(get_flag("profile_continuous")):
+        from multiverso_tpu.obs.profiler import PROFILER
+        PROFILER.hz = max(float(get_flag("profile_hz")), 1e-3)
+        PROFILER.max_frames = int(get_flag("profile_max_frames"))
+        PROFILER.emit_metrics = True
+        PROFILER.start()
     if str(get_flag("slo_spec")).strip() and _slo_engine is None:
         from multiverso_tpu.obs.slo import SLOEngine
         _slo_engine = SLOEngine()
@@ -100,6 +106,8 @@ def _stop_observability() -> None:
     global _slo_engine
     from multiverso_tpu.obs.timeseries import TIMESERIES
     TIMESERIES.stop()
+    from multiverso_tpu.obs.profiler import PROFILER
+    PROFILER.stop()
     if _slo_engine is not None:
         _slo_engine.stop()
         _slo_engine = None
@@ -110,6 +118,15 @@ def slo_engine():
     init); tests and dashboards may also build their own
     :class:`~multiverso_tpu.obs.slo.SLOEngine` directly."""
     return _slo_engine
+
+
+def profiler():
+    """The process-wide sampling profiler
+    (:data:`~multiverso_tpu.obs.profiler.PROFILER`) — running when
+    ``profile_continuous`` was set at init, otherwise idle but usable
+    directly (``mv.profiler().start()`` / ``.sample_once()``)."""
+    from multiverso_tpu.obs.profiler import PROFILER
+    return PROFILER
 
 
 def _configure_profiling() -> None:
@@ -475,6 +492,24 @@ def traces(endpoints: Any, timeout: Optional[float] = None,
     collector = TraceCollector(eps, timeout=timeout)
     collector.collect()
     return collector.stitch(req_id)
+
+
+def attribution(endpoints: Any, timeout: Optional[float] = None,
+                quantile: Optional[float] = None,
+                include_profiles: bool = True):
+    """Fleet latency attribution (``mv.attribution``): pull + stitch the
+    fleet's traces, decompose every span into named critical-path
+    segments (in-process stage gaps and ``wire:`` boundary crossings),
+    and aggregate them into an
+    :class:`~multiverso_tpu.obs.critpath.AttributionReport` — the
+    "p99 Get: 61% replica apply-lag wait, 22% wire" table. ``quantile``
+    (e.g. ``0.99``) restricts aggregation to the slowest tail;
+    ``include_profiles`` annotates the report with each process's
+    sampling profile over the slot-free ``Control_Profile`` RPC."""
+    from multiverso_tpu.obs.critpath import fleet_attribution
+    return fleet_attribution(_fleet_endpoints(endpoints), timeout=timeout,
+                             quantile=quantile,
+                             include_profiles=include_profiles)
 
 
 def top(endpoints: Any, timeout: Optional[float] = None,

@@ -328,6 +328,35 @@ define_string("slo_spec", "",
 define_double("slo_check_interval_seconds", 5.0,
               "seconds between SLO engine evaluations; 0 disables the "
               "engine thread (manual evaluate_now() still works)")
+# Sampling profiler + critical-path attribution (obs/profiler.py,
+# obs/critpath.py; docs/observability.md §13): the "why is it slow"
+# layer — on/off-CPU sampling with named wait sites, PROFILE_* gauges,
+# capture-on-alert, Control_Profile pulls, mv.attribution(fleet).
+define_double("profile_hz", 50.0,
+              "sampling rate of the continuous profiler's frame walker "
+              "(samples per second over sys._current_frames()); values "
+              "<= 0 fall back to 50")
+define_bool("profile_continuous", False,
+            "start the process-wide sampling profiler inside mv.init and "
+            "feed PROFILE_* counters/gauges into the dashboard (and so "
+            "the time-series recorder) on every sampling pass")
+define_bool("profile_on_alert", True,
+            "attach a sampling-profiler report to every slo_burn flight "
+            "dump: the continuous profiler's report when it is running, "
+            "otherwise a short synchronous burst capture (~50ms)")
+define_int("profile_max_frames", 24,
+           "stack-depth cap per collapsed (flamegraph) stack; deeper "
+           "stacks keep their leaf-most frames")
+define_int("flight_recorder_max_bytes", 64 << 20,
+           "size cap for the flight_recorder_path file: once it is at "
+           "least this large, further dumps are suppressed (counted in "
+           "FLIGHT_DUMPS_SUPPRESSED) instead of filling the disk; "
+           "0 = unlimited")
+define_double("flight_recorder_min_interval_seconds", 0.0,
+              "per-REASON rate limit for flight-recorder dumps: a dump "
+              "whose reason fired within this many seconds is suppressed "
+              "(counted in FLIGHT_DUMPS_SUPPRESSED); 0 disables the "
+              "rate limit — a flapping alert should set this to O(10s)")
 define_double("stats_timeout_seconds", 5.0,
               "per-endpoint timeout for the mv.stats_all fan-out: a dead "
               "or wedged endpoint lands on the merged snapshot's "

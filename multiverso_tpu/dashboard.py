@@ -345,6 +345,11 @@ class Dashboard:
             lines.extend(repr(c) for c in cls._counters.values())
             lines.extend(repr(g) for g in cls._gauges.values())
             lines.extend(repr(h) for h in cls._histograms.values())
+        # the "why is it slow" panel rides along once the sampling
+        # profiler has data (rendered OUTSIDE the registry lock)
+        from multiverso_tpu.obs.profiler import PROFILER
+        if PROFILER.samples:
+            lines.append(PROFILER.render())
         text = "\n".join(lines)
         print(text, flush=True)
         return text

@@ -488,10 +488,11 @@ class WarmStandby:
 class ReplicaReadServer:
     """The replica's slot-free read listener (docs/serving.md).
 
-    Answers exactly five frame types — ``Request_Read`` (a watermark-
+    Answers exactly six frame types — ``Request_Read`` (a watermark-
     stamped Get, admission-checked against the request's staleness
     budget), ``Control_Watermark``, ``Control_Stats``,
-    ``Control_Traces`` and heartbeats — and refuses everything else
+    ``Control_Traces``, ``Control_Profile`` and heartbeats — and
+    refuses everything else
     loudly: a replica is not a write target, and a misdirected Add must
     fail visibly rather than fork state.
     Reads run through the standby's dispatcher-serialized seam, so they
@@ -562,6 +563,15 @@ class ReplicaReadServer:
                                   "endpoint": self.endpoint or "",
                                   "t_reply_ns": time.time_ns(),
                                   "traces": TRACES.export(n)})))
+        elif msg.type == MsgType.Control_Profile:
+            from multiverso_tpu.obs.profiler import PROFILER
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Control_Reply_Profile,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode({"role": "replica",
+                                  "endpoint": self.endpoint or "",
+                                  "t_reply_ns": time.time_ns(),
+                                  "profile": PROFILER.report()})))
         else:
             self._reply_error(msg, f"replica serves reads only (got "
                                    f"{msg.type.name}); writes go to the "

@@ -59,6 +59,7 @@ from multiverso_tpu import io as mv_io
 from multiverso_tpu.checkpoint import (
     _run_serialized, load_table, read_array, write_array)
 from multiverso_tpu.dashboard import count, gauge_set, observe
+from multiverso_tpu.obs.profiler import clear_wait, mark_wait
 from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime.contracts import dispatcher_only
 
@@ -243,7 +244,13 @@ class WalWriter:
                 stream.flush()
             elif self.sync == "always":
                 t_sync = time.perf_counter()
-                stream.sync()
+                # profiler wait site: the fsync parks the dispatcher on
+                # the disk, the canonical off-CPU wait of durable mode
+                _prev_wait = mark_wait("wal_fsync")
+                try:
+                    stream.sync()
+                finally:
+                    clear_wait(_prev_wait)
                 # the fsync dominates wal_sync=always appends — its own
                 # distribution separates disk stalls from encode cost
                 observe("WAL_FSYNC_SECONDS", time.perf_counter() - t_sync)

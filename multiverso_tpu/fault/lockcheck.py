@@ -45,6 +45,8 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from multiverso_tpu.obs.profiler import clear_wait, mark_wait
+
 _REAL = {
     "Lock": threading.Lock,
     "RLock": threading.RLock,
@@ -207,7 +209,16 @@ class _CheckedLock:
 
     # -- threading.Lock protocol -------------------------------------
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        if blocking:
+            # profiler wait site: a sampled thread parked here is
+            # off-CPU in lock contention, not burning cycles
+            prev = mark_wait("lock_acquire")
+            try:
+                got = self._inner.acquire(blocking, timeout)
+            finally:
+                clear_wait(prev)
+        else:
+            got = self._inner.acquire(blocking, timeout)
         if not got:
             return False
         if self._reentrant:

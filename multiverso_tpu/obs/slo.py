@@ -230,12 +230,23 @@ class SLOEngine:
                           ev.burn_long)
                 # objective_kind, not kind= — the recorder's own "kind"
                 # field discriminates event/snapshot/trace lines
-                flight_dump("slo_burn", slo=obj.name,
-                            objective_kind=obj.kind,
-                            metric=obj.metric, target=obj.target,
-                            value_short=v_short, value_long=v_long,
-                            burn_short=ev.burn_short,
-                            burn_long=ev.burn_long)
+                details = dict(slo=obj.name,
+                               objective_kind=obj.kind,
+                               metric=obj.metric, target=obj.target,
+                               value_short=v_short, value_long=v_long,
+                               burn_short=ev.burn_short,
+                               burn_long=ev.burn_long)
+                if bool(config.get_flag("profile_on_alert")):
+                    # every slo_burn dump ships a "why": the continuous
+                    # profiler's report, or a short burst on cold
+                    # processes (capture failure must not eat the alert)
+                    try:
+                        from multiverso_tpu.obs.profiler import \
+                            capture_for_alert
+                        details["profile"] = capture_for_alert()
+                    except Exception:  # noqa: BLE001
+                        pass
+                flight_dump("slo_burn", **details)
             elif was and not firing:
                 log.info("slo: %s recovered (short=%.6g target=%.6g)",
                          obj.name, v_short, obj.target)

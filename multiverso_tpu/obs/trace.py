@@ -22,6 +22,7 @@ Stage names are catalogued in ``docs/observability.md``.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -126,15 +127,53 @@ class FlightRecorder:
     def __init__(self, store: TraceStore = TRACES) -> None:
         self.store = store
         self._lock = threading.Lock()
+        # reason -> monotonic time of its last written dump (rate limit)
+        self._last: Dict[str, float] = {}
+
+    def _suppressed(self, reason: str, path: str) -> Optional[str]:
+        """Why this dump must NOT be written (None = write it): the
+        per-reason rate limit or the output-file size cap — a flapping
+        alert must not fill the disk with identical dumps."""
+        from multiverso_tpu import config
+        min_interval = float(
+            config.get_flag("flight_recorder_min_interval_seconds"))
+        if min_interval > 0:
+            last = self._last.get(reason)
+            now = time.monotonic()
+            if last is not None and now - last < min_interval:
+                return (f"reason {reason!r} fired {now - last:.2f}s ago "
+                        f"(< {min_interval:.2f}s min interval)")
+        max_bytes = int(config.get_flag("flight_recorder_max_bytes"))
+        if max_bytes > 0:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size >= max_bytes:
+                return (f"{path} is {size} bytes "
+                        f"(>= flight_recorder_max_bytes={max_bytes})")
+        return None
 
     def dump(self, reason: str, **details: Any) -> Optional[str]:
         """Write one dump; returns the path written, or None when the
-        recorder is disabled. Never raises — a failing dump is logged and
-        swallowed (telemetry must not take down the data path)."""
+        recorder is disabled or the dump is suppressed (size cap /
+        per-reason rate limit — counted in FLIGHT_DUMPS_SUPPRESSED).
+        Never raises — a failing dump is logged and swallowed (telemetry
+        must not take down the data path)."""
         from multiverso_tpu import config, log
         try:
             path = str(config.get_flag("flight_recorder_path"))
             if not path:
+                return None
+            with self._lock:
+                why = self._suppressed(reason, path)
+                if why is None:
+                    self._last[reason] = time.monotonic()
+            if why is not None:
+                from multiverso_tpu.dashboard import count
+                count("FLIGHT_DUMPS_SUPPRESSED")
+                log.info("flight recorder: suppressed %r dump: %s",
+                         reason, why)
                 return None
             n = max(1, int(config.get_flag("flight_recorder_traces")))
             lines = self._render(reason, n, details)

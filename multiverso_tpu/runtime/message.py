@@ -117,6 +117,14 @@ class MsgType(enum.IntEnum):
     # topology under a HIGHER version through the same RPC
     Control_Migrate_Cutover = 45
     Control_Reply_Migrate_Cutover = -45
+    # profile pull RPC (obs/profiler.py + obs/critpath.py): any serving
+    # process ships its sampling-profiler report — per-thread self-time,
+    # wait-site seconds, collapsed stacks — so a collector can attach
+    # "why is it slow" attribution to stitched traces. Slot-free like
+    # the stats/watermark/traces probes: profiling a wedged server is
+    # exactly when every slot is taken
+    Control_Profile = 46
+    Control_Reply_Profile = -46
 
     @property
     def is_server_bound(self) -> bool:

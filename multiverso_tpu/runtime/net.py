@@ -38,6 +38,7 @@ import numpy as np
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_add, observe
+from multiverso_tpu.obs.profiler import clear_wait, mark_wait
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.runtime.shm import ShmChannel
@@ -162,12 +163,17 @@ class _SendState:
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
-    while n > 0:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        n -= len(chunk)
+    # profiler wait site: time parked in recv is wire/peer wait, not CPU
+    prev = mark_wait("net_recv")
+    try:
+        while n > 0:
+            chunk = sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+    finally:
+        clear_wait(prev)
     return b"".join(chunks)
 
 

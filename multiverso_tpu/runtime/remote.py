@@ -521,6 +521,9 @@ class RemoteServer:
         if msg.type == MsgType.Control_Traces:
             self._reply_traces(msg)
             return
+        if msg.type == MsgType.Control_Profile:
+            self._reply_profile(msg)
+            return
         if msg.type == MsgType.Request_Read:
             self._serve_read(msg, compress)
             return
@@ -638,6 +641,22 @@ class RemoteServer:
                               "endpoint": self.endpoint or "",
                               "t_reply_ns": time.time_ns(),
                               "traces": TRACES.export(n)})))
+
+    @slot_free
+    def _reply_profile(self, msg: Message) -> None:
+        """Control_Profile: ship this process's sampling-profiler report
+        (per-thread self-time, wait-site seconds, top collapsed stacks)
+        — the pull half of fleet attribution (obs/critpath.py).
+        Slot-free like the stats probe: a profile of a wedged server is
+        worth the most exactly when every slot is taken."""
+        from multiverso_tpu.obs.profiler import PROFILER
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Profile,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            data=wire.encode({"role": "primary",
+                              "endpoint": self.endpoint or "",
+                              "t_reply_ns": time.time_ns(),
+                              "profile": PROFILER.report()})))
 
     @slot_free
     def _reply_stats(self, msg: Message) -> None:
@@ -869,6 +888,16 @@ def fetch_traces(endpoint: str, timeout: float = 10.0) -> Dict[str, Any]:
     return control_probe(endpoint, MsgType.Control_Traces,
                          MsgType.Control_Reply_Traces,
                          timeout=timeout, what="traces")
+
+
+def fetch_profile(endpoint: str, timeout: float = 10.0) -> Dict[str, Any]:
+    """One-shot profile pull: ``{"role", "endpoint", "t_reply_ns",
+    "profile": <SamplingProfiler.report()>}`` from any serving process
+    (primary or replica), slot-free. The report is empty-but-valid when
+    the remote runs without ``profile_continuous``."""
+    return control_probe(endpoint, MsgType.Control_Profile,
+                         MsgType.Control_Reply_Profile,
+                         timeout=timeout, what="profile")
 
 
 def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:

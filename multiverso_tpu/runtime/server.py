@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_set, monitor, observe
+from multiverso_tpu.obs.profiler import clear_wait, mark_wait
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.contracts import dispatcher_only
 from multiverso_tpu.runtime.message import Message, MsgType
@@ -239,7 +240,13 @@ class Server:
         fuse = self.fuses_adds and self._apply_batch_cap > 0
         queue_gauge = _apply_metrics()[3]
         while True:
-            msgs = self._queue.pop_all()
+            # profiler wait site: an idle dispatcher parks here; time in
+            # the drain is "no work", everything after is dispatch cost
+            _prev_wait = mark_wait("dispatcher_drain")
+            try:
+                msgs = self._queue.pop_all()
+            finally:
+                clear_wait(_prev_wait)
             if msgs is None:
                 return
             # depth AFTER the drain = requests that arrived behind this

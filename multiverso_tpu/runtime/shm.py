@@ -55,6 +55,7 @@ import time
 from typing import Any, List, Optional
 
 from multiverso_tpu import config
+from multiverso_tpu.obs.profiler import clear_wait, mark_wait
 
 MAGIC = 0x4D56534D  # 'MVSM'
 VERSION = 1
@@ -237,27 +238,38 @@ class Ring:
         cap = self.capacity
         q = self._q
         data = self._data
-        while written < n:
-            if self._disposed or self.reader_closed or (
-                    self.writer_closed and not written):
-                raise OSError("shm: ring closed")
-            head = q[_Q_HEAD]
-            free = cap - (head - q[_Q_TAIL])
-            if free == 0:
-                if idle == 0:
-                    _shm_metrics()[3].add(1)  # SHM_RING_FULL_WAITS
-                idle += 1
-                _sleep_for(idle)
-                continue
-            idle = 0
-            chunk = min(n - written, free)
-            pos = head % cap
-            first = min(chunk, cap - pos)
-            data[pos:pos + first] = src[written:written + first]
-            if chunk > first:
-                data[:chunk - first] = src[written + first:written + chunk]
-            q[_Q_HEAD] = head + chunk  # AFTER the copy: release the bytes
-            written += chunk
+        _prev_wait = None
+        try:
+            while written < n:
+                if self._disposed or self.reader_closed or (
+                        self.writer_closed and not written):
+                    raise OSError("shm: ring closed")
+                head = q[_Q_HEAD]
+                free = cap - (head - q[_Q_TAIL])
+                if free == 0:
+                    if idle == 0:
+                        _shm_metrics()[3].add(1)  # SHM_RING_FULL_WAITS
+                        # profiler wait site: backpressure from a slow
+                        # reader — marked across the whole idle stretch
+                        _prev_wait = mark_wait("shm_ring_spin")
+                    idle += 1
+                    _sleep_for(idle)
+                    continue
+                if idle:
+                    clear_wait(_prev_wait)
+                idle = 0
+                chunk = min(n - written, free)
+                pos = head % cap
+                first = min(chunk, cap - pos)
+                data[pos:pos + first] = src[written:written + first]
+                if chunk > first:
+                    data[:chunk - first] = \
+                        src[written + first:written + chunk]
+                q[_Q_HEAD] = head + chunk  # AFTER the copy: release bytes
+                written += chunk
+        finally:
+            if idle:
+                clear_wait(_prev_wait)
         return n
 
     # -- consumer ------------------------------------------------------------
@@ -271,26 +283,37 @@ class Ring:
         cap = self.capacity
         q = self._q
         data = self._data
-        while got < n:
-            if self._disposed or self.reader_closed:
-                raise ConnectionError("shm: ring closed")
-            tail = q[_Q_TAIL]
-            avail = q[_Q_HEAD] - tail
-            if avail == 0:
-                if self.writer_closed:
-                    raise ConnectionError("shm: peer closed")
-                idle += 1
-                _sleep_for(idle)
-                continue
-            idle = 0
-            chunk = min(n - got, avail)
-            pos = tail % cap
-            first = min(chunk, cap - pos)
-            out[got:got + first] = data[pos:pos + first]
-            if chunk > first:
-                out[got + first:got + chunk] = data[:chunk - first]
-            q[_Q_TAIL] = tail + chunk  # AFTER the copy: free the space
-            got += chunk
+        _prev_wait = None
+        try:
+            while got < n:
+                if self._disposed or self.reader_closed:
+                    raise ConnectionError("shm: ring closed")
+                tail = q[_Q_TAIL]
+                avail = q[_Q_HEAD] - tail
+                if avail == 0:
+                    if self.writer_closed:
+                        raise ConnectionError("shm: peer closed")
+                    if idle == 0:
+                        # profiler wait site: spinning for the peer's
+                        # next frame — the shm analog of net_recv
+                        _prev_wait = mark_wait("shm_ring_spin")
+                    idle += 1
+                    _sleep_for(idle)
+                    continue
+                if idle:
+                    clear_wait(_prev_wait)
+                idle = 0
+                chunk = min(n - got, avail)
+                pos = tail % cap
+                first = min(chunk, cap - pos)
+                out[got:got + first] = data[pos:pos + first]
+                if chunk > first:
+                    out[got + first:got + chunk] = data[:chunk - first]
+                q[_Q_TAIL] = tail + chunk  # AFTER the copy: free the space
+                got += chunk
+        finally:
+            if idle:
+                clear_wait(_prev_wait)
         return bytes(out)
 
 

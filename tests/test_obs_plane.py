@@ -14,7 +14,12 @@ Covers the plane's charter:
 * slo_spec parsing (loud ValueError on malformed clauses) and the
   edge-triggered burn-rate alert -> tagged flight-recorder dump;
 * labeled Prometheus exposition (``mvtpu_*{shard=,role=}``) + escaping;
-* ``bench.py --compare`` regression verdicts and exit codes;
+* TimeSeriesRecorder rate/delta clamping at zero across a
+  ``Dashboard.reset()`` straddling the window;
+* the flight recorder's per-reason rate limit + output-size cap
+  (``FLIGHT_DUMPS_SUPPRESSED``);
+* ``bench.py --compare`` regression verdicts and exit codes, plus the
+  environment-fingerprint warn / ``--require-same-env`` refusal path;
 * ``mv.stats_all`` partial results with a killed replica;
 * ACCEPTANCE: one Get through a 2-shard x 1-replica fleet with
   ``read_preference=replica`` yields a single stitched trace with >= 6
@@ -40,7 +45,7 @@ from multiverso_tpu.obs.collector import (StitchedTrace, TraceCollector,
                                           estimate_offset)
 from multiverso_tpu.obs.slo import Objective, SLOEngine, parse_slo_spec
 from multiverso_tpu.obs.timeseries import TimeSeriesRecorder
-from multiverso_tpu.obs.trace import TRACES, TraceStore
+from multiverso_tpu.obs.trace import FlightRecorder, TRACES, TraceStore
 from multiverso_tpu.runtime.message import Message, MsgType
 
 SEED = int(os.environ.get("CHAOS_SEED", "7"))
@@ -229,6 +234,32 @@ def test_timeseries_windowed_quantile_differences_history_out():
     assert rec.quantile("TSP_NO_SUCH", 0.99, 60.0) == 0.0
 
 
+def test_timeseries_rate_delta_clamp_across_dashboard_reset():
+    """``Dashboard.reset()`` mid-window drops cumulative counters below
+    older ring samples; windowed rate/delta must clamp at zero — a
+    registry reset is not a negative event rate."""
+    rec = TimeSeriesRecorder(interval=100.0, samples=16)
+    count("TSP_RESET_CTR", 100)
+    rec.sample_now(t=100.0)
+    count("TSP_RESET_CTR", 50)
+    rec.sample_now(t=110.0)
+    assert rec.delta("TSP_RESET_CTR", 60.0) == 50
+    Dashboard.reset()                       # counter 150 -> 0 in place
+    count("TSP_RESET_CTR", 5)
+    rec.sample_now(t=120.0)
+    # window spans the reset: 5 < 100, clamp — never negative
+    assert rec.delta("TSP_RESET_CTR", 60.0) == 0
+    assert rec.rate("TSP_RESET_CTR", 60.0) == 0.0
+    # gauge view answers the post-reset truth, series stays monotonic in t
+    assert rec.series("counter", "TSP_RESET_CTR") == [
+        (100.0, 100.0), (110.0, 150.0), (120.0, 5.0)]
+    # once the window no longer straddles the reset, rates recover
+    count("TSP_RESET_CTR", 15)
+    rec.sample_now(t=130.0)
+    assert rec.delta("TSP_RESET_CTR", 15.0) == 15
+    assert rec.rate("TSP_RESET_CTR", 15.0) == pytest.approx(1.5)
+
+
 # -- slo_spec parsing ----------------------------------------------------------
 
 def test_parse_slo_spec_clauses_and_errors():
@@ -318,6 +349,47 @@ def test_prom_labels_and_escaping():
         in prom
 
 
+# -- flight recorder: size cap + per-reason rate limit -------------------------
+
+def test_flight_recorder_per_reason_rate_limit(tmp_path):
+    path = str(tmp_path / "flight-rate.jsonl")
+    mv.set_flag("flight_recorder_path", path)
+    mv.set_flag("flight_recorder_min_interval_seconds", 3600.0)
+    rec = FlightRecorder(store=TraceStore())
+    before = Dashboard.counter_value("FLIGHT_DUMPS_SUPPRESSED")
+    assert rec.dump("eviction", worker=1) == path
+    # same reason inside the interval: suppressed + counted, file untouched
+    size = os.path.getsize(path)
+    assert rec.dump("eviction", worker=2) is None
+    assert os.path.getsize(path) == size
+    assert Dashboard.counter_value("FLIGHT_DUMPS_SUPPRESSED") == before + 1
+    # a DIFFERENT reason is not rate-limited by the first one
+    assert rec.dump("failover") == path
+    with open(path, encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()
+                  and json.loads(l)["kind"] == "event"]
+    assert [e["reason"] for e in events] == ["eviction", "failover"]
+    mv.set_flag("flight_recorder_min_interval_seconds", 0.0)
+    # interval 0 (the default) disables the rate limit entirely
+    assert rec.dump("eviction") == path
+
+
+def test_flight_recorder_size_cap_suppresses(tmp_path):
+    path = str(tmp_path / "flight-cap.jsonl")
+    mv.set_flag("flight_recorder_path", path)
+    rec = FlightRecorder(store=TraceStore())
+    assert rec.dump("crc_reject") == path          # first dump writes
+    mv.set_flag("flight_recorder_max_bytes", 64)   # file already bigger
+    before = Dashboard.counter_value("FLIGHT_DUMPS_SUPPRESSED")
+    size = os.path.getsize(path)
+    assert rec.dump("crc_reject") is None
+    assert rec.dump("some_other_reason") is None   # cap gates every reason
+    assert os.path.getsize(path) == size
+    assert Dashboard.counter_value("FLIGHT_DUMPS_SUPPRESSED") == before + 2
+    mv.set_flag("flight_recorder_max_bytes", 64 << 20)
+    assert rec.dump("crc_reject") == path          # headroom back -> writes
+
+
 # -- bench --compare regression gate ------------------------------------------
 
 def test_bench_compare_verdicts_and_exit_codes(tmp_path):
@@ -346,6 +418,46 @@ def test_bench_compare_verdicts_and_exit_codes(tmp_path):
     assert bench._run_compare(["bench.py", "--compare", pa, pok]) == 0
     assert bench._run_compare(["bench.py", "--compare", pa, pbad]) == 1
     assert bench._run_compare(["bench.py", "--compare", pa]) == 2
+
+
+def test_bench_compare_env_fingerprint_warn_and_refuse(tmp_path, capsys):
+    """Cross-environment comparisons warn (or refuse under
+    ``--require-same-env``): a Mac-vs-TPU "regression" is not evidence."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+    env_a = {"hostname": "laptop", "nproc": 8, "jax_backend": "cpu",
+             "device_kind": "cpu", "device_count": 1}
+    env_b = {**env_a, "hostname": "tpu-vm", "device_kind": "TPU v4",
+             "device_count": 4}
+    a = {"ps_words_per_sec": 100_000.0, "env": env_a}
+    b = {"ps_words_per_sec": 100_000.0, "env": env_b}
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    for payload, dst in ((a, pa), (b, pb)):
+        with open(dst, "w") as fh:
+            json.dump(payload, fh)
+    assert bench._env_mismatch(env_a, env_b) == [
+        "device_count", "device_kind", "hostname"]
+    # the env dict itself is NOT a compared metric: no bogus regressions
+    assert bench.bench_compare(pa, pb, threshold=0.10) == []
+    out = capsys.readouterr().out
+    assert "WARNING: environment fingerprints differ" in out
+    assert "device_kind: A='cpu'  B='TPU v4'" in out
+    # refuse-or-warn: --require-same-env turns the warning into exit 2
+    assert bench._run_compare(
+        ["bench.py", "--compare", pa, pb, "--require-same-env"]) == 2
+    err = capsys.readouterr().err
+    assert "refusing to compare" in err
+    # same env (or a pre-fingerprint file with none): no warning, exit 0
+    assert bench._run_compare(
+        ["bench.py", "--compare", pa, pa, "--require-same-env"]) == 0
+    assert "WARNING" not in capsys.readouterr().out
+    del a["env"]
+    with open(pa, "w") as fh:
+        json.dump(a, fh)
+    assert bench._env_mismatch(bench._load_bench_env(pa), env_b) == []
+    assert bench._run_compare(
+        ["bench.py", "--compare", pa, pb, "--require-same-env"]) == 0
 
 
 # -- fleet acceptance: stitched trace + partial stats --------------------------

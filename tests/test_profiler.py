@@ -1,0 +1,343 @@
+"""Continuous profiling + critical-path attribution ("why is it slow").
+
+Covers PR 12's charter (docs/observability.md §13):
+* the wait-site registry: mark/clear nesting, the ``wait_site`` context
+  manager, and the always-on hooks' exception safety;
+* ``SamplingProfiler.sample_once`` deterministic classification: tagged
+  off-CPU beats the blocking-frame heuristic beats on-CPU, weights are
+  seconds-per-sample, ``blocked:*`` pseudo-sites stay out of the
+  ``wait_seconds`` attribution;
+* collapsed-stack output (thread-name prefix, root-first, ``[wait:..]``
+  leaf, ``max_frames`` truncation) and continuous-mode ``PROFILE_*``
+  metric emission;
+* lockcheck's ``_CheckedLock``: a thread stuck behind a held lock shows
+  up off-CPU at ``lock_acquire``;
+* ``capture_for_alert`` (running-profiler report vs. cold burst) and the
+  SLO burn dump carrying a ``profile`` field under ``profile_on_alert``;
+* critpath: segment decomposition (same-process vs ``wire:``, negative
+  clamp), dominant extraction, aggregation + tail quantile, render;
+* the slot-free ``Control_Profile`` RPC round-trip;
+* ACCEPTANCE: ChaosNet delaying every Get by 60 ms makes the Get wire
+  segment the dominant entry of ``mv.attribution`` — injected latency is
+  correctly attributed, deterministically.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.fault.lockcheck import _CheckedLock
+from multiverso_tpu.obs.collector import StitchedTrace
+from multiverso_tpu.obs.critpath import (attribute, dominant,
+                                         fleet_attribution, segments)
+from multiverso_tpu.obs.profiler import (PROFILER, SamplingProfiler,
+                                         WAIT_SITES, capture_for_alert,
+                                         clear_wait, current_wait,
+                                         mark_wait, wait_site)
+from multiverso_tpu.obs.slo import Objective, SLOEngine
+from multiverso_tpu.obs.timeseries import TimeSeriesRecorder
+from multiverso_tpu.obs.trace import TRACES
+
+SEED = int(os.environ.get("CHAOS_SEED", "7"))
+
+
+class _Parked:
+    """A helper thread parked off-CPU (Event.wait) under an optional
+    wait-site tag until released — a deterministic sampling target."""
+
+    def __init__(self, site=None, name="parked"):
+        self.site = site
+        self.ready = threading.Event()
+        self.release = threading.Event()
+        self.thread = threading.Thread(target=self._run, name=name,
+                                       daemon=True)
+        self.thread.start()
+        assert self.ready.wait(5.0)
+        time.sleep(0.01)  # let the thread actually enter Event.wait
+
+    def _run(self):
+        prev = mark_wait(self.site) if self.site else None
+        self.ready.set()
+        try:
+            self.release.wait(30.0)
+        finally:
+            if self.site:
+                clear_wait(prev)
+
+    def stop(self):
+        self.release.set()
+        self.thread.join(5.0)
+
+
+# -- wait-site registry --------------------------------------------------------
+
+def test_wait_site_registry_nesting_and_context_manager():
+    assert current_wait() is None
+    prev = mark_wait("net_recv")
+    assert prev is None and current_wait() == "net_recv"
+    inner = mark_wait("wal_fsync")         # nested site shadows...
+    assert inner == "net_recv" and current_wait() == "wal_fsync"
+    clear_wait(inner)                      # ...and restores the outer tag
+    assert current_wait() == "net_recv"
+    clear_wait(prev)
+    assert current_wait() is None
+    with pytest.raises(RuntimeError):
+        with wait_site("dispatcher_drain"):
+            assert current_wait() == "dispatcher_drain"
+            raise RuntimeError("boom")
+    assert current_wait() is None          # exception-safe clear
+    assert set(WAIT_SITES) == {"lock_acquire", "net_recv", "wal_fsync",
+                               "dispatcher_drain", "shm_ring_spin"}
+
+
+# -- sample classification -----------------------------------------------------
+
+def test_sample_once_classifies_tagged_blocked_and_on_cpu():
+    prof = SamplingProfiler(hz=50.0, max_frames=24)
+    tagged = _Parked(site="wal_fsync", name="prof-tagged")
+    untagged = _Parked(site=None, name="prof-untagged")
+    spin = threading.Event()
+    done = threading.Event()
+
+    def _burn():
+        while not done.is_set():
+            spin.is_set()  # pure-python busy loop: on-CPU when sampled
+
+    burner = threading.Thread(target=_burn, name="prof-burner", daemon=True)
+    burner.start()
+    try:
+        for _ in range(10):
+            out = prof.sample_once(weight=0.02)
+        assert out["sites"].get("wal_fsync") == 1
+        assert out["sites"].get("blocked:wait", 0) >= 1  # Event.wait frame
+        rep = prof.report()
+        assert rep["samples"] == 10
+        # tagged thread: 10 samples x 20ms, all off-CPU at wal_fsync
+        info = rep["threads"]["prof-tagged"]
+        assert info["off_cpu"] == pytest.approx(0.2)
+        assert info["waits"] == {"wal_fsync": pytest.approx(0.2)}
+        # untagged parked thread: heuristic, not the wait_seconds table
+        assert rep["threads"]["prof-untagged"]["waits"] == {
+            "blocked:wait": pytest.approx(0.2)}
+        # the per-site table counts the tagged wait (leftover runtime
+        # threads from earlier tests may add their own sites) and never
+        # the blocked:* pseudo-sites
+        assert rep["wait_seconds"]["wal_fsync"] == pytest.approx(0.2)
+        assert not any(s.startswith("blocked:")
+                       for s in rep["wait_seconds"])
+        # busy loop is on-CPU self-time
+        assert rep["threads"]["prof-burner"]["on_cpu"] > 0
+        assert "prof-tagged" in prof.render()
+    finally:
+        done.set()
+        tagged.stop()
+        untagged.stop()
+        burner.join(5.0)
+
+
+def test_collapsed_stacks_shape_and_truncation():
+    prof = SamplingProfiler(hz=100.0, max_frames=3)
+    parked = _Parked(site="net_recv", name="prof-collapse")
+    try:
+        prof.sample_once()
+    finally:
+        parked.stop()
+    lines = [l for l in prof.collapsed().splitlines()
+             if l.startswith("prof-collapse;")]
+    assert lines, prof.collapsed()
+    stack, n = lines[0].rsplit(" ", 1)
+    assert int(n) == 1
+    frames = stack.split(";")
+    # thread name + <= max_frames frames + the wait-site leaf
+    assert frames[0] == "prof-collapse"
+    assert frames[-1] == "[wait:net_recv]"
+    assert len(frames) <= 1 + 3 + 1
+    assert prof.collapsed(limit=1).count("\n") == 0
+
+
+def test_continuous_metrics_emission_and_lifecycle():
+    prof = SamplingProfiler(hz=200.0, max_frames=24, emit_metrics=True)
+    parked = _Parked(site="shm_ring_spin", name="prof-emit")
+    try:
+        prof.sample_once(weight=0.005)
+        prof.sample_once(weight=0.005)
+        assert Dashboard.counter_value("PROFILE_SAMPLES") == 2
+        snap = Dashboard.snapshot()
+        assert snap["gauges"]["PROFILE_THREADS"] >= 1
+        assert snap["gauges"]["PROFILE_OFF_CPU_THREADS"] >= 1
+        assert snap["gauges"]["PROFILE_WAIT_SHM_RING_SPIN_SECONDS"] == \
+            pytest.approx(0.01)
+    finally:
+        parked.stop()
+    # the sampler thread is a clock around sample_once
+    prof.reset()
+    assert prof.samples == 0
+    prof.start()
+    assert prof.running and prof._thread.name == "mv-profiler"
+    deadline = time.monotonic() + 5.0
+    while prof.samples == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    prof.stop()
+    assert not prof.running
+    assert prof.samples > 0
+
+
+def test_checked_lock_contention_attributes_to_lock_acquire():
+    """A thread stuck behind a held ``_CheckedLock`` samples off-CPU at
+    ``lock_acquire`` — the lock-hold half of the §13 acceptance bar."""
+    prof = SamplingProfiler(hz=100.0, max_frames=24)
+    lock = _CheckedLock()
+    waiting = threading.Event()
+    assert lock.acquire()
+    try:
+        contender = threading.Thread(
+            target=lambda: (waiting.set(), lock.acquire(), lock.release()),
+            name="prof-contender", daemon=True)
+        contender.start()
+        assert waiting.wait(5.0)
+        time.sleep(0.02)  # the contender is now inside inner.acquire()
+        out = prof.sample_once(weight=0.01)
+        assert out["sites"].get("lock_acquire") == 1
+        rep = prof.report()
+        assert rep["threads"]["prof-contender"]["waits"] == {
+            "lock_acquire": pytest.approx(0.01)}
+        assert rep["wait_seconds"]["lock_acquire"] == pytest.approx(0.01)
+    finally:
+        lock.release()
+        contender.join(5.0)
+    assert current_wait(contender.ident) is None  # tag cleaned up
+
+
+# -- capture-on-alert ----------------------------------------------------------
+
+def test_capture_for_alert_prefers_running_profiler_else_bursts():
+    prof = SamplingProfiler(hz=100.0, max_frames=24)
+    prof.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while prof.samples == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        rep = capture_for_alert(prof)
+        assert rep["samples"] == prof.report()["samples"] or \
+            rep["samples"] > 0
+    finally:
+        prof.stop()
+    cold = SamplingProfiler(hz=100.0, max_frames=24)
+    burst = capture_for_alert(cold)     # not running -> synchronous burst
+    assert burst["samples"] == 10
+    assert cold.samples == 0            # the burst used its own instance
+
+
+def test_slo_burn_dump_ships_a_profile(tmp_path):
+    path = str(tmp_path / "flight-profile.jsonl")
+    mv.set_flag("flight_recorder_path", path)
+    rec = TimeSeriesRecorder(interval=100.0, samples=16)
+    engine = SLOEngine(recorder=rec, objectives=[
+        Objective(name="slow", kind="counter", metric="PROF_SLO_CTR",
+                  target=1.0, windows=(20.0, 100.0))])
+    rec.sample_now(t=0.0)
+    Dashboard.counter("PROF_SLO_CTR").add(10_000)
+    rec.sample_now(t=10.0)
+    assert engine.evaluate_now()[0].firing
+    with open(path, encoding="utf-8") as fh:
+        event = next(json.loads(l) for l in fh
+                     if json.loads(l)["kind"] == "event")
+    assert event["reason"] == "slo_burn"
+    profile = event["profile"]          # profile_on_alert defaults true
+    assert profile["samples"] > 0 and "threads" in profile
+
+
+# -- critical-path attribution -------------------------------------------------
+
+def _span(req_id, hops):
+    return StitchedTrace(req_id=req_id, hops=hops)
+
+
+def test_segments_dominant_and_negative_clamp():
+    t = _span(7, [("local", "client_send", 1_000_000),
+                  ("srv", "server_recv", 3_000_000),
+                  ("srv", "apply", 2_000_000),       # residual skew
+                  ("srv", "reply_sent", 10_000_000)])
+    segs = segments(t)
+    assert segs == [("wire:client_send->server_recv", pytest.approx(0.002)),
+                    ("server_recv->apply", 0.0),     # clamped, not negative
+                    ("apply->reply_sent", pytest.approx(0.008))]
+    name, sec, share = dominant(t)
+    assert name == "apply->reply_sent"
+    assert share == pytest.approx(0.8)
+    assert dominant(_span(8, [("local", "only_hop", 0)])) is None
+
+
+def test_attribute_aggregates_and_quantile_selects_tail():
+    fast = [_span(i, [("local", "a", 0), ("local", "b", 1_000_000)])
+            for i in range(9)]
+    slow = _span(99, [("local", "a", 0), ("remote", "b", 91_000_000)])
+    report = attribute(fast + [slow])
+    assert report.traces == 10
+    assert report.dominant["segment"] == "wire:a->b"
+    assert report.dominant["total_ms"] == pytest.approx(91.0)
+    assert report.dominant["count"] == 1
+    ab = next(r for r in report.rows if r["segment"] == "a->b")
+    assert ab["count"] == 9 and ab["mean_ms"] == pytest.approx(1.0)
+    assert sum(r["share"] for r in report.rows) == pytest.approx(1.0)
+    # p90 cut keeps only the single slowest span
+    tail = attribute(fast + [slow], quantile=0.9)
+    assert tail.traces == 1
+    assert [r["segment"] for r in tail.rows] == ["wire:a->b"]
+    assert "p90" in tail.render() and "wire:a->b" in tail.render()
+    # profiles annotate the render
+    annotated = attribute([slow], profiles={
+        "primary@x": {"wait_seconds": {"wal_fsync": 1.25}}})
+    assert "wal_fsync=1.250s" in annotated.render()
+    assert annotated.to_dict()["profiles"]["primary@x"]
+    empty = attribute([])
+    assert empty.dominant is None and "no multi-hop" in empty.render()
+
+
+# -- Control_Profile RPC + end-to-end attribution ------------------------------
+
+def test_control_profile_rpc_and_chaos_delay_attribution(tmp_path):
+    """ACCEPTANCE: with ChaosNet delaying every Request_Get by 60 ms,
+    the fleet attribution table's dominant segment is the Get's wire
+    hop — the injected latency lands where the analyzer says it does."""
+    from multiverso_tpu.runtime.remote import fetch_profile
+    TRACES.reset()
+    PROFILER.reset()
+    mv.init(remote_workers=1,
+            fault_spec="delay:type=Request_Get,prob=1.0,seconds=0.06",
+            fault_seed=SEED)
+    table = mv.create_table("array", 8, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    rt = client.table(table.table_id)
+    rt.add(np.ones(8, np.float32))
+    for _ in range(5):
+        rt.get()                        # each Get eats the 60 ms delay
+    # slot-free profile RPC answers while the data plane is under chaos
+    payload = fetch_profile(endpoint)
+    assert payload["role"] == "primary"
+    assert payload["endpoint"] == endpoint
+    assert "samples" in payload["profile"]
+    report = mv.attribution([endpoint])
+    dom = report.dominant
+    assert dom is not None, report.render()
+    # the 60 ms injected delay dwarfs every real segment (<~1 ms each):
+    # it must surface as THE dominant segment, on a Get wire hop
+    assert dom["segment"].startswith("wire:"), report.render()
+    assert dom["share"] > 0.5, report.render()
+    assert dom["mean_ms"] > 50.0, report.render()
+    client.close()
+    mv.shutdown()
+
+
+def test_fleet_attribution_skips_unreachable_endpoints():
+    TRACES.reset()  # drop earlier tests' local spans from the pull
+    report = fleet_attribution(["127.0.0.1:1"], timeout=0.3)
+    assert report.traces == 0 and report.profiles == {}
