@@ -36,15 +36,21 @@
 #                       A/B, batch-size sweep, shm vs TCP RTT/throughput
 #   make read-bench     read-path A/B only: Zipf hot-key Gets, primary vs
 #                       replica vs replica+cache vs hedged
+#   make tiered         beyond-RAM tiered-storage smoke: cold-segment
+#                       codec, admission/LRU policy, tiered-vs-plain
+#                       equivalence, SIGKILL-mid-demotion recovery drill
+#                       (MV_TIER_KILL=before_commit|after_commit selects
+#                       one chaos arm; docs/tiered_storage.md)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 CHAOS_SEED ?= 7
 
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
-	profile-smoke native test dryrun bench apply-bench read-bench clean
+	profile-smoke native test dryrun bench apply-bench read-bench tiered \
+	clean
 
-check: lint native test dryrun profile-smoke bench
+check: lint native test dryrun profile-smoke tiered bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -103,6 +109,10 @@ apply-bench:
 
 read-bench:
 	$(CPU_ENV) $(PYTHON) bench.py --read-bench
+
+tiered:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_tiered.py -q \
+		-p no:cacheprovider -p no:randomly
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -1296,6 +1296,102 @@ def bench_read(rows=8192, cols=32, seconds=5.0, zipf_s=1.6,
     return result
 
 
+def bench_tiered(key_space=600_000, width=8, ratio=10, ops=40_000,
+                 zipf_s=1.1, read_fraction=0.95, cold_bits=8):
+    """Tiered beyond-RAM serving (docs/tiered_storage.md): a
+    TieredSparseServer holding a table ``ratio``x larger than its
+    hot-tier budget, under the TrafficGen Zipf op stream (s≈1.1 — the
+    recommender skew). The hot set is pre-warmed to steady state (the
+    generator's top ranks are touched enough to pass admission — what a
+    live server reaches after its first traffic minutes), then the
+    measured window reports the converged hot-tier hit rate and
+    throughput via counter deltas. In-process and CPU-only: this
+    measures the tiering machinery, not silicon."""
+    import shutil
+    import tempfile
+
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.tables.sparse_table import TieredSparseServer
+
+    table_bytes = key_space * width * 4
+    resident = table_bytes // ratio
+    hot_rows = resident // (width * 4)
+    tier_dir = tempfile.mkdtemp(prefix="mvtier_bench_")
+    server = TieredSparseServer(key_space, width,
+                                resident_bytes=resident,
+                                cold_bits=cold_bits, tier_dir=tier_dir)
+    try:
+        rng = np.random.default_rng(0)
+        t0 = time.perf_counter()
+        batch = 50_000
+        for start in range(0, key_space, batch):
+            keys = np.arange(start, min(start + batch, key_space),
+                             dtype=np.int64)
+            vals = rng.standard_normal((len(keys), width)).astype(np.float32)
+            server.process_add((keys, vals, None))
+        populate_s = time.perf_counter() - t0
+
+        gen = TrafficGen(key_space, zipf_s=zipf_s,
+                         read_fraction=read_fraction, seed=3)
+        # steady-state warm: rank r's key is gen._perm[r]; touching the
+        # top `hot_rows` ranks via the Add path (zero deltas — value
+        # no-ops) promotes exactly the set Zipf traffic keeps hot
+        warm = np.ascontiguousarray(gen._perm[:hot_rows], dtype=np.int64)
+        zeros = np.zeros((4096, width), np.float32)
+        for start in range(0, len(warm), 4096):
+            chunk = warm[start:start + 4096]
+            server.process_add((chunk, zeros[:len(chunk)], None))
+
+        hot0 = Dashboard.counter_value("TIER_HOT_HITS")
+        cold0 = Dashboard.counter_value("TIER_COLD_HITS")
+        demo0 = Dashboard.counter_value("TIER_DEMOTIONS")
+        promo0 = Dashboard.counter_value("TIER_PROMOTIONS")
+        one = np.ones((1, width), np.float32)
+        key = np.zeros(1, np.int64)
+        gets = adds = 0
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            kind, k = gen.next_op()
+            key[0] = k
+            if kind == "get":
+                server.process_get((key, None))
+                gets += 1
+            else:
+                server.process_add((key, one, None))
+                adds += 1
+        elapsed = time.perf_counter() - t0
+        hot = Dashboard.counter_value("TIER_HOT_HITS") - hot0
+        cold = Dashboard.counter_value("TIER_COLD_HITS") - cold0
+        stats = server.tier_stats()
+        raw_cold = stats["cold_rows"] * (width * 4 + 8)  # row + key bytes
+        return {
+            "tiered_key_space": key_space,
+            "tiered_width": width,
+            "tiered_table_mb": round(table_bytes / 2 ** 20, 2),
+            "tiered_resident_mb": round(resident / 2 ** 20, 2),
+            "tiered_size_ratio": round(table_bytes / resident, 2),
+            "tiered_cold_bits": cold_bits,
+            "tiered_zipf_s": zipf_s,
+            "tiered_ops": ops,
+            "tiered_hot_hit_rate": round(hot / max(1, hot + cold), 4),
+            "tiered_ops_per_sec": round(ops / elapsed, 1),
+            "tiered_gets_per_sec": round(gets / elapsed, 1),
+            "tiered_cold_fetches": cold,
+            "tiered_promotions":
+                Dashboard.counter_value("TIER_PROMOTIONS") - promo0,
+            "tiered_demotions":
+                Dashboard.counter_value("TIER_DEMOTIONS") - demo0,
+            "tiered_populate_rows_per_sec": round(key_space / populate_s, 1),
+            "tiered_cold_compression_x": round(
+                raw_cold / max(1, stats["cold_bytes"]), 2),
+            "tiered_hot_rows": stats["hot_rows"],
+            "tiered_cold_rows": stats["cold_rows"],
+        }
+    finally:
+        server._tier.close()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+
 def probe_gbps(probe_mb=128):
     """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
     donated-pass loop, min-of-3. ~1s; the load thermometer every gated
@@ -1414,6 +1510,10 @@ def main():
     if _ATTRIBUTE:
         _collect_leg_attribution("read", attribution_tables)
     try:
+        tiered = bench_tiered()
+    except Exception as exc:  # the tiered leg must not sink the figures
+        tiered = {"tiered_bench_error": repr(exc)[:300]}
+    try:
         prof_overhead = bench_profile_overhead()
     except Exception as exc:  # the profiler leg must not sink the figures
         prof_overhead = {"profile_overhead_error": repr(exc)[:300]}
@@ -1440,6 +1540,7 @@ def main():
         **mh,
         **sharded,
         **read,
+        **tiered,
         **prof_overhead,
         "env": _env_fingerprint(),
     }
@@ -1632,6 +1733,11 @@ if __name__ == "__main__":
         print(json.dumps(_single_leg_result(
             {"metric": "read_gets_per_sec_replica_cache",
              **bench_read()})))
+    elif "--tiered-bench" in sys.argv[1:]:
+        # tiered beyond-RAM leg only (`make tiered` smoke / operators):
+        # 10x-over-budget table under Zipf, reports hot-tier hit rate
+        print(json.dumps(_single_leg_result(
+            {"metric": "tiered_hot_hit_rate", **bench_tiered()})))
     elif "--compare" in sys.argv[1:]:
         # regression diff of two result files (CI runs non-blocking)
         sys.exit(_run_compare(sys.argv))

@@ -654,8 +654,11 @@ def net() :
 # -- tables -----------------------------------------------------------------
 
 from multiverso_tpu.tables.array_table import ArrayServer, ArrayWorker  # noqa: E402
-from multiverso_tpu.tables.kv_table import DeviceKVServer, KVServer, KVWorker  # noqa: E402
+from multiverso_tpu.tables.kv_table import (  # noqa: E402
+    DeviceKVServer, KVServer, KVWorker, TieredKVServer, make_tiered_kv)
 from multiverso_tpu.tables.matrix_table import MatrixServer, MatrixWorker  # noqa: E402
+from multiverso_tpu.tables.sparse_table import (  # noqa: E402
+    SparseWorker, TieredSparseServer, make_tiered_sparse)
 from multiverso_tpu.updaters import AddOption, GetOption  # noqa: E402,F401
 
 ArrayTableHandler = ArrayWorker  # python-binding names
@@ -665,6 +668,10 @@ _TABLE_TYPES = {
     "array": ArrayWorker,
     "matrix": MatrixWorker,
     "kv": KVWorker,
+    "sparse": SparseWorker,
+    # beyond-RAM variants (multiverso_tpu/store/, docs/tiered_storage.md)
+    "tiered_sparse": make_tiered_sparse,
+    "tiered_kv": make_tiered_kv,
 }
 
 

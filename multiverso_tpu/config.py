@@ -274,6 +274,28 @@ define_string("wal_dir", "",
               "before it is ACKed; restart recovery = mv.durable_recover() "
               "(snapshot + WAL replay + dedup-window rebuild), compaction "
               "= CheckpointDriver(..., wal=mv.wal_writer()). Empty disables")
+
+# Tiered beyond-RAM storage (multiverso_tpu/store/): hot/cold row tiers
+# for the sparse/KV table kinds (docs/tiered_storage.md).
+define_int("tier_resident_bytes", 64 << 20,
+           "hot-tier byte budget per tiered table: row payload bytes kept "
+           "RAM-resident; the LRU tail past it is demoted to quantized "
+           "cold segments on disk")
+define_int("tier_cold_bits", 8,
+           "quantization width for cold-tier rows (1/2/4/8, float32 tables "
+           "only — Seide et al. 2014 packing, lossy by ≤ step/2 per "
+           "element); 0 stores raw bytes (lossless, any dtype)")
+define_string("tier_dir", "",
+              "cold-tier spill root (one root per process, like wal_dir): "
+              "each tiered table spills under <tier_dir>/tier<ordinal>, "
+              "reused+wiped across restarts. Empty = fresh tempdir per "
+              "table (spill is per-incarnation; durability is snapshot+WAL)")
+define_int("tier_admit_touches", 2,
+           "frequency-sketch touches a cold key needs before a Get promotes "
+           "it back to the hot tier (second-chance admission: a one-shot "
+           "scan cannot thrash the Zipf-hot working set); Adds always "
+           "promote")
+
 # Telemetry subsystem (multiverso_tpu/obs/): latency histograms, gauges,
 # per-request tracing, flight recorder, metrics JSONL, stats RPC
 # (docs/observability.md).

@@ -4,11 +4,12 @@ Two cooperating pieces live here:
 
 * A **wait-site registry** — a per-thread tag (``mark_wait`` /
   ``clear_wait`` / the ``wait_site`` context manager) that blocking code
-  paths set around the five canonical places a multiverso thread parks:
+  paths set around the six canonical places a multiverso thread parks:
   lock acquisition (``fault/lockcheck.py``), socket reads
   (``runtime/net.py:_read_exact``), WAL fsync (``durable/wal.py``),
-  dispatcher queue drain (``runtime/server.py``), and the shm ring
-  backoff ladder (``runtime/shm.py``).  Marking costs two dict
+  dispatcher queue drain (``runtime/server.py``), the shm ring
+  backoff ladder (``runtime/shm.py``), and cold-tier segment fetches
+  (``store/coldstore.py``).  Marking costs two dict
   operations under the GIL and is paid whether or not a profiler is
   running, so the hooks are always-on and essentially free.
 
@@ -45,6 +46,7 @@ WAIT_SITES = (
     "wal_fsync",          # durable/wal.py      WriteAheadLog.append sync
     "dispatcher_drain",   # runtime/server.py   Server._main pop_all
     "shm_ring_spin",      # runtime/shm.py      Ring read/write backoff
+    "tier_cold_fetch",    # store/coldstore.py  ColdStore segment read+decode
 )
 
 # thread ident -> wait-site name.  Mutated with single dict ops only

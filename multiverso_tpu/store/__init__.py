@@ -1,0 +1,14 @@
+"""Tiered beyond-RAM table storage (docs/tiered_storage.md).
+
+``TieredStore`` keeps a table's hot rows RAM-resident under
+``tier_resident_bytes`` and spills the cold tail to quantized,
+CRC-framed on-disk segments (``ColdStore``); the sparse/KV server
+tables plug it in behind their normal ``process_add``/``process_get``
+contracts (tables/sparse_table.py, tables/kv_table.py)."""
+
+from multiverso_tpu.store.coldstore import ColdStore
+from multiverso_tpu.store.tiered import (
+    DEMOTE_BATCH_ROWS, FrequencySketch, TieredStore)
+
+__all__ = ["ColdStore", "DEMOTE_BATCH_ROWS", "FrequencySketch",
+           "TieredStore"]

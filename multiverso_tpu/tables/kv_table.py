@@ -93,6 +93,82 @@ class KVServer(ServerTable):
             self._store[k] = v
 
 
+class TieredKVServer(KVServer):
+    """KVServer whose value store is hot/cold tiered (multiverso_tpu/
+    store/, docs/tiered_storage.md). Scalars ride the tier as width-1
+    rows; numeric dtypes only (the host KVServer also stores python
+    objects — those cannot spill to fixed-width segments).
+
+    ``remote_spec`` still reports ``kind=kv``, so remote proxies and
+    every durability/replication layer treat it as a plain KV table."""
+
+    def __init__(self, value_dtype: Any = np.float32,
+                 resident_bytes: Optional[int] = None,
+                 cold_bits: Optional[int] = None,
+                 tier_dir: Optional[str] = None,
+                 admit_touches: Optional[int] = None) -> None:
+        super().__init__(value_dtype)
+        if self.value_dtype.kind not in "fiu":
+            log.fatal("tiered KV values must be numeric (got %s); the "
+                      "in-RAM KV table handles object values",
+                      self.value_dtype)
+        from multiverso_tpu.store import TieredStore
+        self._tier = TieredStore(1, self.value_dtype,
+                                 resident_bytes=resident_bytes,
+                                 cold_bits=cold_bits, directory=tier_dir,
+                                 admit_touches=admit_touches)
+        self._store = None  # any missed base-class path must fail loudly
+
+    def process_add(self, request) -> None:
+        keys, values, _option = request
+        tier = self._tier
+        dtype = self.value_dtype
+        for k, v in zip(keys, values):
+            k = int(k)
+            row = tier.get_for_update(k)
+            if row is None:
+                tier.put(k, np.array([v], dtype=dtype))
+            else:
+                row[0] = row[0] + dtype.type(v)
+        tier.maybe_maintain()
+
+    def process_get(self, request):
+        keys, _option = request
+        if keys is None:
+            return {int(k): self.value_dtype.type(row[0])
+                    for k, row in self._tier.items()}
+        zero = self.value_dtype.type(0)
+        out = []
+        for k in keys:
+            row = self._tier.get(int(k))
+            out.append(self.value_dtype.type(row[0])
+                       if row is not None else zero)
+        return out
+
+    # KVServer.store/load read self._store directly — snapshot through
+    # the tier instead (same wire format, so snapshots interchange).
+    def store(self, stream) -> None:
+        items = sorted((int(k), self.value_dtype.type(row[0]))
+                       for k, row in self._tier.items())
+        stream.write(struct.pack("<q", len(items)))
+        for k, v in items:
+            stream.write(struct.pack("<q", k))
+            stream.write(np.asarray(v, dtype=self.value_dtype).tobytes())
+
+    def load(self, stream) -> None:
+        (count,) = struct.unpack("<q", stream.read(8))
+        self._tier.clear()
+        item = self.value_dtype.itemsize
+        for _ in range(count):
+            (k,) = struct.unpack("<q", stream.read(8))
+            v = np.frombuffer(stream.read(item), dtype=self.value_dtype)[0]
+            self._tier.put(int(k), np.array([v], dtype=self.value_dtype))
+        self._tier.maybe_maintain()
+
+    def tier_stats(self) -> Dict[str, int]:
+        return self._tier.stats()
+
+
 class DeviceKVServer(ServerTable):
     """Hash-sharded device-resident KV store (see module docstring)."""
 
@@ -355,3 +431,13 @@ class KVWorker(WorkerTable):
         norm = [int(k) for k in keys]
         vals = [self.value_dtype.type(v) for v in values]
         return norm, vals
+
+
+def make_tiered_kv(value_dtype: Any = np.float32,
+                   **tier_kwargs: Any) -> KVWorker:
+    """Factory for ``register_table_type("tiered_kv", ...)``: a KVWorker
+    served by a beyond-RAM :class:`TieredKVServer` (``tier_kwargs``:
+    resident_bytes / cold_bits / tier_dir / admit_touches; defaults come
+    from the ``tier_*`` flags)."""
+    return KVWorker(value_dtype, server=TieredKVServer(value_dtype,
+                                                       **tier_kwargs))

@@ -1,0 +1,78 @@
+"""FTRL table (multiverso_tpu/tables/ftrl_table.py) — its first direct
+coverage: the closed-form weight derivation, the server update against a
+pure-numpy FTRL-proximal reference, checkpoint roundtrip, and the
+streaming-CTR example (examples/ftrl_ctr.py) actually learning a SPARSE
+model (reference capability:
+Applications/LogisticRegression/src/util/ftrl_sparse_table.h:12-90)."""
+
+import numpy as np
+
+import multiverso_tpu as mv
+from multiverso_tpu.io import MemoryStream
+from multiverso_tpu.tables.ftrl_table import FTRLWorker, ftrl_weights
+
+
+def test_ftrl_weights_closed_form():
+    """|z| <= lambda1 -> weight EXACTLY zero (the l1 shrinkage that makes
+    FTRL models sparse); beyond the threshold the sign flips against z."""
+    z = np.array([0.5, -0.5, 2.0, -2.0], np.float32)
+    n = np.ones(4, np.float32)
+    w = np.asarray(ftrl_weights(z, n, alpha=0.5, beta=1.0,
+                                lambda1=1.0, lambda2=1.0))
+    np.testing.assert_array_equal(w[:2], [0.0, 0.0])
+    assert w[2] < 0 < w[3]
+    # closed form: -(sign(z)(|z|-l1)) / ((beta+sqrt(n))/alpha + l2)
+    np.testing.assert_allclose(w[2], -(2.0 - 1.0) / ((1 + 1) / 0.5 + 1.0),
+                               rtol=1e-6)
+
+
+def _numpy_ftrl(grads, alpha, beta, l1, l2):
+    """Dense FTRL-proximal reference (McMahan et al., per-coordinate)."""
+    z = np.zeros_like(grads[0])
+    n = np.zeros_like(grads[0])
+    for g in grads:
+        w = -np.sign(z) * np.maximum(np.abs(z) - l1, 0.0) / (
+            (beta + np.sqrt(n)) / alpha + l2)
+        sigma = (np.sqrt(n + g * g) - np.sqrt(n)) / alpha
+        z = z + g - sigma * w
+        n = n + g * g
+    return -np.sign(z) * np.maximum(np.abs(z) - l1, 0.0) / (
+        (beta + np.sqrt(n)) / alpha + l2)
+
+
+def test_ftrl_server_matches_numpy_reference(mv_env):
+    kw = dict(alpha=0.3, beta=1.0, lambda1=0.1, lambda2=0.5)
+    mv.register_table_type("ftrl", FTRLWorker)
+    table = mv.create_table("ftrl", 16, **kw)
+    rng = np.random.default_rng(5)
+    grads = [rng.normal(0, 1, 16).astype(np.float32) for _ in range(20)]
+    for g in grads:
+        table.add(g)
+    want = _numpy_ftrl(grads, kw["alpha"], kw["beta"],
+                       kw["lambda1"], kw["lambda2"])
+    np.testing.assert_allclose(table.get(), want, rtol=1e-4, atol=1e-6)
+
+
+def test_ftrl_checkpoint_roundtrip(mv_env):
+    mv.register_table_type("ftrl", FTRLWorker)
+    table = mv.create_table("ftrl", 8, alpha=0.5)
+    rng = np.random.default_rng(6)
+    for _ in range(5):
+        table.add(rng.normal(0, 1, 8).astype(np.float32))
+    buf = MemoryStream()
+    table._server_table.store(buf)
+    buf.seek(0)
+    table2 = mv.create_table("ftrl", 8, alpha=0.5)
+    table2._server_table.load(buf)
+    np.testing.assert_allclose(table2.get(), table.get(), rtol=1e-6)
+
+
+def test_ftrl_ctr_example_learns_sparse_model():
+    """The runnable streaming-CTR demo must beat the chance-level
+    baseline on held-out clicks AND produce a mostly-zero weight vector
+    (observed ~0.57 logloss / ~0.88 sparsity at the default config)."""
+    from examples.ftrl_ctr import main
+
+    logloss, sparsity = main(verbose=False)
+    assert logloss < 0.65, f"FTRL CTR example did not learn: {logloss}"
+    assert sparsity > 0.5, f"l1 produced a dense model: {sparsity}"

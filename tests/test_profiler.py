@@ -94,7 +94,8 @@ def test_wait_site_registry_nesting_and_context_manager():
             raise RuntimeError("boom")
     assert current_wait() is None          # exception-safe clear
     assert set(WAIT_SITES) == {"lock_acquire", "net_recv", "wal_fsync",
-                               "dispatcher_drain", "shm_ring_spin"}
+                               "dispatcher_drain", "shm_ring_spin",
+                               "tier_cold_fetch"}
 
 
 # -- sample classification -----------------------------------------------------

@@ -99,6 +99,76 @@ def test_error_feedback_row_addressed_residuals():
     assert not np.array_equal(ef.residual[ids], before[ids])
 
 
+@pytest.mark.parametrize("bits", BITS)
+def test_quant_empty_row_set(bits):
+    """n=0 encodes to a bare header and decodes to an empty float32 array
+    — the cold store writes row batches and a filtered batch can be empty."""
+    x = np.zeros(0, np.float32)
+    payload = q.quant_encode(x, bits)
+    assert len(payload) == 24
+    dec = q.quant_decode(payload, 0)
+    assert dec.dtype == np.float32 and dec.shape == (0,)
+    # error feedback with an empty id batch: residuals untouched
+    ef = q.ErrorFeedback((4, 2), bits)
+    before = ef.residual.copy()
+    ef.compress(np.zeros((0, 2), np.float32), ids=np.array([], np.int64))
+    np.testing.assert_array_equal(ef.residual, before)
+
+
+@pytest.mark.parametrize("bits", BITS)
+def test_quant_concatenated_rows_with_odd_lengths(bits):
+    """A multi-row payload quantized as ONE blob (the cold-segment shape:
+    rows concatenated, one lo/step for the batch) where every row length
+    is a non-multiple of the per-byte packing factor: each row slices
+    back out within the shared step bound."""
+    rng = np.random.default_rng(17 * bits)
+    lengths = (3, 7, 13, 5)  # none divisible by 8/bits for any bits
+    rows = [(rng.normal(size=n) * 3).astype(np.float32) for n in lengths]
+    flat = np.concatenate(rows)
+    payload = q.quant_encode(flat, bits)
+    dec = q.quant_decode(payload, flat.size)
+    step = np.frombuffer(payload, np.float32, 1, offset=20)[0]
+    off = 0
+    for row in rows:
+        got = dec[off:off + len(row)]
+        assert np.abs(got - row).max() <= step / 2 + 1e-6
+        off += len(row)
+
+
+def test_quant_dtype_coercion_contract():
+    """The codec is float32 end to end: wider/narrower inputs coerce on
+    encode and ALWAYS decode as float32 (callers owning other dtypes —
+    e.g. the cold store — must convert explicitly, never rely on the
+    codec to remember)."""
+    for dtype in (np.float64, np.float16, np.int32):
+        x = np.arange(8).astype(dtype)
+        dec = q.quant_decode(q.quant_encode(x, 8), 8)
+        assert dec.dtype == np.float32
+        np.testing.assert_allclose(dec, x.astype(np.float32), atol=8 / 255)
+
+
+@pytest.mark.parametrize("bits", BITS)
+def test_quant_roundtrip_plus_residual_reconstructs(bits):
+    """decoded + (original - decoded) reproduces the original on a
+    concatenated multi-row payload — the invariant that lets error
+    feedback claim quantization error is deferred, not lost. At 4/8 bits
+    the float32 residual is small against the decoded value and the
+    reconstruction is BIT-exact; at 1/2 bits the residual rivals the
+    decoded magnitude, so float32 addition rounds — bounded by one ulp
+    of the operands, never by the (huge) quantization step."""
+    rng = np.random.default_rng(23 + bits)
+    flat = np.concatenate(
+        [(rng.normal(size=n) * 2).astype(np.float32) for n in (9, 11, 30)])
+    dec = q.quant_decode(q.quant_encode(flat, bits), flat.size)
+    residual = flat - dec
+    back = (dec + residual).astype(np.float32)
+    if bits >= 4:
+        np.testing.assert_array_equal(back, flat)
+    else:
+        ulp = np.spacing(np.maximum(np.abs(dec), np.abs(residual)))
+        assert (np.abs(back - flat) <= ulp).all()
+
+
 def test_error_feedback_beats_plain_quantization():
     """Accumulating a constant gradient at 1 bit: with error feedback the
     accumulated table tracks the true sum; without it the bias is
