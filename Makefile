@@ -41,6 +41,14 @@
 #                       equivalence, SIGKILL-mid-demotion recovery drill
 #                       (MV_TIER_KILL=before_commit|after_commit selects
 #                       one chaos arm; docs/tiered_storage.md)
+#   make audit          fleet integrity plane: state digests + continuous
+#                       divergence auditor, consistent cut → PITR/clone
+#                       roundtrips, migration gap-resync units
+#                       (MV_CUT_KILL=coordinator|shard arms the
+#                       kill-mid-cut chaos drills; docs/fault_tolerance.md
+#                       §8, docs/observability.md §14)
+#   make audit-bench    auditor-overhead A/B + one timed consistent cut
+#                       against a live 2-shard group
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -48,9 +56,9 @@ CHAOS_SEED ?= 7
 
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
-	clean
+	audit audit-bench clean
 
-check: lint native test dryrun profile-smoke tiered bench
+check: lint native test dryrun profile-smoke tiered audit bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -113,6 +121,14 @@ read-bench:
 tiered:
 	$(CPU_ENV) $(PYTHON) -m pytest tests/test_tiered.py -q \
 		-p no:cacheprovider -p no:randomly
+
+audit:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_audit.py tests/test_cut.py \
+		tests/test_migrate_unit.py -q \
+		-p no:cacheprovider -p no:randomly
+
+audit-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --audit-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

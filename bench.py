@@ -1124,6 +1124,72 @@ def bench_sharded(shards, rows=4096, cols=32, batch_rows=256,
     }
 
 
+def bench_audit(rows=4096, cols=32, batch_rows=256, n_batches=160,
+                window=32, audit_interval=0.2):
+    """Fleet-integrity-plane overhead A/B (docs/observability.md §audit):
+    the same windowed row-Add stream against a live 2-shard group, timed
+    with the auditor off and then with the background ``mv.audit`` sweep
+    digesting every member at ``audit_interval`` — the digest fold runs
+    dispatcher-serialized on each shard, so this measures exactly what a
+    production fleet pays for continuous divergence auditing
+    (``audit_overhead_pct``, min-of-3 both legs). One consistent cut of
+    the loaded fleet is timed alongside (``cut_fleet_seconds``) so the
+    PITR snapshot cost rides every BENCH_*.json."""
+    import multiverso_tpu as mv
+    from multiverso_tpu.shard.group import ShardGroup
+
+    group = ShardGroup(
+        [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+        shards=2, durable=True, flags={"remote_workers": 4}).start()
+    try:
+        client = group.connect()
+        table = client.table(0)
+        rng = np.random.default_rng(0)
+        batches = [rng.choice(rows, batch_rows, replace=False)
+                   .astype(np.int32) for _ in range(16)]
+        vals = np.ones((batch_rows, cols), np.float32)
+        for b in batches[:4]:  # warm every shard's jit buckets
+            table.add(vals, row_ids=b)
+
+        def leg():
+            best = float("inf")
+            for _ in range(3):
+                handles = []
+                t0 = time.perf_counter()
+                for i in range(n_batches):
+                    handles.append(table.add_async(vals,
+                                                   row_ids=batches[i % 16]))
+                    if len(handles) >= window:
+                        table.wait(handles.pop(0))
+                for h in handles:
+                    table.wait(h)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        base = leg()
+        auditor = mv.audit(group, interval=audit_interval)
+        try:
+            audited = leg()
+        finally:
+            auditor.stop()
+        sweeps = (auditor.last_report or {}).get("shards", [])
+        t0 = time.perf_counter()
+        mv.cut_fleet(group, cut_id="bench")
+        cut_seconds = time.perf_counter() - t0
+        client.close()
+        overhead = (audited - base) / base * 100.0 if base > 0 else 0.0
+        return {
+            "audit_overhead_pct": round(overhead, 2),
+            "audit_base_seconds": round(base, 6),
+            "audit_audited_seconds": round(audited, 6),
+            "audit_interval_seconds": audit_interval,
+            "audit_members_per_sweep": len(sweeps),
+            "cut_fleet_seconds": round(cut_seconds, 4),
+        }
+    finally:
+        group.stop()
+
+
 class TrafficGen:
     """Realistic serving-traffic generator (the ROADMAP scenario item's
     first slice): Zipfian key skew over a permuted key space, a
@@ -1517,6 +1583,10 @@ def main():
         prof_overhead = bench_profile_overhead()
     except Exception as exc:  # the profiler leg must not sink the figures
         prof_overhead = {"profile_overhead_error": repr(exc)[:300]}
+    try:
+        audit = bench_audit()
+    except Exception as exc:  # the audit leg must not sink the figures
+        audit = {"audit_bench_error": repr(exc)[:300]}
     result = {
         "metric": "word2vec_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
@@ -1542,6 +1612,7 @@ def main():
         **read,
         **tiered,
         **prof_overhead,
+        **audit,
         "env": _env_fingerprint(),
     }
     if attribution_tables:
@@ -1733,6 +1804,11 @@ if __name__ == "__main__":
         print(json.dumps(_single_leg_result(
             {"metric": "read_gets_per_sec_replica_cache",
              **bench_read()})))
+    elif "--audit-bench" in sys.argv[1:]:
+        # fleet-integrity leg only (`make audit` CI job / operators):
+        # background-auditor overhead A/B + one timed consistent cut
+        print(json.dumps(_single_leg_result(
+            {"metric": "audit_overhead_pct", **bench_audit()})))
     elif "--tiered-bench" in sys.argv[1:]:
         # tiered beyond-RAM leg only (`make tiered` smoke / operators):
         # 10x-over-budget table under Zipf, reports hot-tier hit rate

@@ -21,7 +21,7 @@ Public surface (MV_* parity):
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Iterator, Optional, Sequence
+from typing import Any, Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
@@ -602,6 +602,77 @@ def warm_standby(primary_endpoint: str, service_endpoint: str,
     return WarmStandby(primary_endpoint, service_endpoint, tables=tables,
                        lease_seconds=lease_seconds,
                        takeover=takeover).start()
+
+
+# -- fleet integrity plane (obs/audit.py + durable/cut.py) -------------------
+
+def digest(endpoint: str, timeout: Optional[float] = None):
+    """Per-table content digests of any serving endpoint — primary,
+    replica, or standby serving reads — at its exact watermark:
+    ``{"role", "endpoint", "watermark", "layout_version", "tables":
+    {tid: {"digest", "rows"}}}``. Order-independent over (id,
+    row-bytes), so primaries, replicas and tiered/plain interchanges
+    compare equal iff their applied state is equal. Slot-free."""
+    from multiverso_tpu.runtime.remote import fetch_digest
+    if timeout is None:
+        timeout = float(get_flag("audit_timeout_seconds"))
+    return fetch_digest(endpoint, timeout=timeout)
+
+
+def audit(fleet, interval: Optional[float] = None,
+          manifest: Optional[Dict[str, Any]] = None):
+    """The continuous fleet auditor (obs/audit.py): compare
+    primary↔replica state digests at a common watermark and check the
+    acked-Add conservation ledger across probes; on mismatch fire
+    ``AUDIT_DIVERGENCE`` through the flight-recorder path with both
+    digests and the watermark vector attached. Returns a
+    :class:`~multiverso_tpu.obs.audit.FleetAuditor` — already running in
+    the background when ``interval`` (or the ``audit_interval_seconds``
+    flag) is > 0; call ``.check()`` yourself for a one-shot report."""
+    from multiverso_tpu.obs.audit import FleetAuditor
+    auditor = FleetAuditor(fleet, interval=interval, manifest=manifest)
+    if auditor.interval > 0:
+        auditor.start()
+    return auditor
+
+
+def cut_fleet(fleet, cut_id: Optional[str] = None,
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+    """Take a watermark-consistent cut of a serving fleet
+    (durable/cut.py): fan the slot-free ``Control_Cut`` marker over
+    every shard primary — each drains its dispatcher, snapshots at its
+    ``WalWriter.seq`` fence, replies fence + digests — and commit the
+    atomic fleet manifest under ``<base_dir>/cuts/``. ``fleet`` is a
+    ShardGroup or its base_dir. Returns the committed manifest; raises
+    (committing NOTHING) if any member failed mid-cut."""
+    from multiverso_tpu.durable.cut import cut_fleet as _cut
+    return _cut(fleet, cut_id=cut_id, timeout=timeout)
+
+
+def restore_fleet(manifest=None, base_dir: Optional[str] = None,
+                  replicas: int = 0, standby: bool = False,
+                  timeout: float = 240.0):
+    """Point-in-time recovery (durable/cut.py): bring up a fresh
+    ShardGroup restored to a committed cut — every shard at the SAME
+    manifest's fence, dedup windows seeded from the cut's acked-Add
+    ledger. ``manifest`` is a cut manifest dict, a fleet base_dir (its
+    LATEST cut), or a manifest path. Returns the started ShardGroup."""
+    from multiverso_tpu.durable.cut import restore_fleet as _restore
+    return _restore(manifest, base_dir=base_dir, replicas=replicas,
+                    standby=standby, timeout=timeout)
+
+
+def clone_fleet(source, base_dir: Optional[str] = None, replicas: int = 0,
+                timeout: float = 240.0):
+    """Blue/green bring-up (durable/cut.py): bootstrap a fresh
+    ShardGroup from a LIVE fleet — each clone shard absorbs one quiesced
+    ``Control_Replicate`` transfer from its source primary, then serves
+    under its own WAL lineage. ``source`` is a ShardGroup, its base_dir,
+    or a cut manifest (endpoints name the donors). Returns the started
+    clone group."""
+    from multiverso_tpu.durable.cut import clone_fleet as _clone
+    return _clone(source, base_dir=base_dir, replicas=replicas,
+                  timeout=timeout)
 
 
 # -- raw net mode (MV_NetBind / MV_NetConnect / MV_NetFinalize) --------------

@@ -379,6 +379,17 @@ define_double("flight_recorder_min_interval_seconds", 0.0,
               "whose reason fired within this many seconds is suppressed "
               "(counted in FLIGHT_DUMPS_SUPPRESSED); 0 disables the "
               "rate limit — a flapping alert should set this to O(10s)")
+define_double("audit_interval_seconds", 0.0,
+              "period of the continuous fleet auditor (mv.audit): every "
+              "interval it pulls Control_Digest from each primary and "
+              "replica, compares them at a common watermark and fires "
+              "AUDIT_DIVERGENCE through the flight-recorder path on "
+              "mismatch; 0 = one-shot checks only (no background thread)")
+define_double("audit_timeout_seconds", 30.0,
+              "per-endpoint timeout for Control_Digest / Control_Cut "
+              "probes: a dead or wedged member lands on the audit "
+              "report's unreachable list (or fails the cut) instead of "
+              "hanging the coordinator")
 define_double("stats_timeout_seconds", 5.0,
               "per-endpoint timeout for the mv.stats_all fan-out: a dead "
               "or wedged endpoint lands on the merged snapshot's "

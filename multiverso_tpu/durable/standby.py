@@ -55,7 +55,7 @@ import numpy as np
 
 from multiverso_tpu import config, log
 from multiverso_tpu import io as mv_io
-from multiverso_tpu.dashboard import Dashboard, count, gauge_set
+from multiverso_tpu.dashboard import Dashboard, count, gauge_set, observe
 from multiverso_tpu.fault.detector import LivenessDetector
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.fault.inject import make_net
@@ -488,11 +488,12 @@ class WarmStandby:
 class ReplicaReadServer:
     """The replica's slot-free read listener (docs/serving.md).
 
-    Answers exactly six frame types — ``Request_Read`` (a watermark-
+    Answers exactly seven frame types — ``Request_Read`` (a watermark-
     stamped Get, admission-checked against the request's staleness
     budget), ``Control_Watermark``, ``Control_Stats``,
-    ``Control_Traces``, ``Control_Profile`` and heartbeats — and
-    refuses everything else
+    ``Control_Traces``, ``Control_Profile``, ``Control_Digest`` (the
+    fleet auditor's state-digest probe, obs/audit.py) and heartbeats —
+    and refuses everything else
     loudly: a replica is not a write target, and a misdirected Add must
     fail visibly rather than fork state.
     Reads run through the standby's dispatcher-serialized seam, so they
@@ -572,6 +573,8 @@ class ReplicaReadServer:
                                   "endpoint": self.endpoint or "",
                                   "t_reply_ns": time.time_ns(),
                                   "profile": PROFILER.report()})))
+        elif msg.type == MsgType.Control_Digest:
+            self._reply_digest(msg)
         else:
             self._reply_error(msg, f"replica serves reads only (got "
                                    f"{msg.type.name}); writes go to the "
@@ -641,6 +644,30 @@ class ReplicaReadServer:
                               "primary_watermark": s.primary_watermark,
                               "lag": s.lag_records(),
                               "primary_dead": bool(s.primary_dead)})))
+
+    @slot_free
+    def _reply_digest(self, msg: Message) -> None:
+        """Control_Digest: per-table content digests at this replica's
+        EXACT applied watermark — computed under the replay-serialized
+        seam, so the (digest, watermark) pair names one precise state.
+        The fleet auditor compares it against the primary's digest at
+        the same watermark; a mismatch is real divergence, not skew."""
+        from multiverso_tpu.obs.audit import digest_payload
+        s = self._standby
+        t0 = time.perf_counter()
+
+        def run():
+            return digest_payload(
+                s._tables, role="replica", endpoint=self.endpoint or "",
+                watermark=int(s.applied_watermark), layout_version=-1)
+
+        payload = s._run(run)
+        observe("AUDIT_DIGEST_SECONDS", time.perf_counter() - t0)
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Digest,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            watermark=int(payload.get("watermark", -1)),
+            data=wire.encode(payload)))
 
     @slot_free
     def _reply_error(self, msg: Message, text: str) -> None:

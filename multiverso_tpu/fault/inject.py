@@ -259,6 +259,61 @@ class ChaosNet(TcpNet):
         timer.start()
 
 
+def corrupt_table_row(table, row: int) -> bool:
+    """Flip one byte of ``row``'s APPLIED state in a server table — the
+    seeded-divergence half of the audit chaos drills (MV_AUDIT_CORRUPT,
+    shard/_child.py). Wire-level ``corrupt`` rules cannot stage this:
+    the frame CRC discards a corrupted record before it applies, so it
+    degrades to a drop. Real divergence — a bad host, a buggy updater, a
+    torn restore — lives in applied state, which is what the fleet
+    auditor's digests compare. Call under the owning dispatcher seam
+    (``run_serialized`` / ``WarmStandby._run``); returns False when the
+    row cannot be located."""
+    import numpy as np
+    server = getattr(table, "_server_table", table)
+    action = "state_corrupt"
+    row = int(row)
+
+    def flip(arr: np.ndarray) -> bool:
+        view = arr.view(np.uint8).reshape(-1)
+        if view.size == 0:
+            return False
+        view[0] ^= 0x01
+        count(f"FAULT_INJECTED_{action.upper()}")
+        log.error("chaos: corrupted applied state of table %s row %d "
+                  "(drill)", getattr(server, "table_id", "?"), row)
+        return True
+
+    z = getattr(server, "_z", None)
+    if isinstance(z, dict) and isinstance(z.get(row), np.ndarray):
+        return flip(z[row])
+    tier = getattr(server, "_tier", None)
+    if tier is not None:
+        cold = tier.get(row)
+        if cold is None:
+            return False
+        arr = np.array(cold, copy=True)
+        ok = flip(arr)
+        if ok:
+            tier.put(row, arr)
+        return ok
+    store = getattr(server, "_store", None)
+    if isinstance(store, dict):
+        value = store.get(row)
+        if isinstance(value, np.ndarray):
+            return flip(value)
+        if value is not None:
+            store[row] = (value ^ 1 if isinstance(value, int)
+                          else repr(value) + "\x00")
+            count(f"FAULT_INJECTED_{action.upper()}")
+            return True
+        return False
+    if isinstance(store, np.ndarray):
+        target = store[row] if store.ndim > 1 and row < len(store) else store
+        return flip(np.atleast_1d(target))
+    return False
+
+
 def make_net() -> TcpNet:
     """Transport factory keyed on the chaos flags: plain TcpNet normally, a
     ChaosNet under ``fault_spec`` — the seam that lets any test or bench

@@ -125,6 +125,24 @@ class MsgType(enum.IntEnum):
     # exactly when every slot is taken
     Control_Profile = 46
     Control_Reply_Profile = -46
+    # consistent-cut marker RPC (durable/cut.py): a fleet coordinator
+    # fans this over every shard primary; the shard drains its
+    # dispatcher, snapshots every table at its WAL fence into a
+    # cut_<id>/ directory OUTSIDE the compaction lineage, and replies
+    # the fence + per-table digests. The coordinator commits the atomic
+    # fleet manifest only after every member answered — a shard killed
+    # mid-cut (the MV_CUT_KILL drill) fails the whole cut and the
+    # previous manifest stays the recovery point
+    Control_Cut = 47
+    Control_Reply_Cut = -47
+    # state-digest probe (obs/audit.py): any serving process — primary,
+    # replica, standby serving reads — answers with an order-independent
+    # per-table content digest at its current watermark, computed under
+    # its dispatcher seam so the (digest, watermark) pair is exact.
+    # Slot-free like the stats/watermark probes: auditing a wedged or
+    # diverged server is exactly when every slot is taken
+    Control_Digest = 48
+    Control_Reply_Digest = -48
 
     @property
     def is_server_bound(self) -> bool:

@@ -38,7 +38,9 @@ host hops, ``src/table/sparse_matrix_table.cpp:147-153``).
 from __future__ import annotations
 
 import itertools
+import os
 import random
+import signal
 import threading
 import time
 from collections import OrderedDict
@@ -524,6 +526,12 @@ class RemoteServer:
         if msg.type == MsgType.Control_Profile:
             self._reply_profile(msg)
             return
+        if msg.type == MsgType.Control_Digest:
+            self._reply_digest(msg)
+            return
+        if msg.type == MsgType.Control_Cut:
+            self._handle_cut(msg)
+            return
         if msg.type == MsgType.Request_Read:
             self._serve_read(msg, compress)
             return
@@ -657,6 +665,65 @@ class RemoteServer:
                               "endpoint": self.endpoint or "",
                               "t_reply_ns": time.time_ns(),
                               "profile": PROFILER.report()})))
+
+    @slot_free
+    def _reply_digest(self, msg: Message) -> None:
+        """Control_Digest: per-table order-independent content digests at
+        this primary's EXACT append watermark — digest and fence are read
+        in one dispatcher-serialized block, so no Add can land between
+        them. Slot-free like the stats probe: auditing a wedged or
+        diverged server is exactly when every slot is taken."""
+        from multiverso_tpu.obs.audit import digest_payload
+        server = self._zoo.server
+        t0 = time.perf_counter()
+
+        def run():
+            wal = server.wal
+            return digest_payload(
+                server._tables, role="primary", endpoint=self.endpoint or "",
+                watermark=int(wal.seq) if wal is not None else -1,
+                layout_version=self.layout_version)
+
+        payload = server.run_serialized(run, timeout=None)
+        observe("AUDIT_DIGEST_SECONDS", time.perf_counter() - t0)
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Digest,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            watermark=int(payload.get("watermark", -1)),
+            data=wire.encode(payload)))
+
+    @slot_free
+    def _handle_cut(self, msg: Message) -> None:
+        """Control_Cut: snapshot every table at this shard's WAL fence
+        (durable/cut.py) and reply the fence + digests. Runs on the pump
+        thread — the only thread that enqueues wire requests — so the
+        dispatcher-serialized capture block drains everything already
+        accepted and fences out everything after, the same quiesce shape
+        as the Control_Replicate transfer. A durability-less server
+        refuses: without a WAL there is no fence to cut at."""
+        from multiverso_tpu.durable import cut as cut_mod
+        if self._zoo.server.wal is None:
+            self._net.send_via(msg._conn, Message(
+                src=0, dst=msg.src, type=MsgType.Reply_Error,
+                msg_id=msg.msg_id, req_id=msg.req_id,
+                data=wire.encode("consistent cuts need durability: start "
+                                 "the server with the wal_dir flag")))
+            return
+        request = wire.decode(msg.data) if msg.data else {}
+        request = request if isinstance(request, dict) else {}
+        reply = cut_mod.capture_cut(self, str(request.get("cut_id", "adhoc")))
+        if request.get("kill") == "shard":
+            # chaos drill (MV_CUT_KILL=shard): die AFTER the local
+            # snapshot but BEFORE replying — the coordinator sees a
+            # timeout, the cut fails, and the previous manifest must
+            # remain the fleet's recovery point
+            log.error("cut: MV_CUT_KILL=shard — dying before the cut "
+                      "reply (drill)")
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Control_Reply_Cut,
+            msg_id=msg.msg_id, req_id=msg.req_id,
+            watermark=int(reply["fence"]), data=wire.encode(reply)))
 
     @slot_free
     def _reply_stats(self, msg: Message) -> None:
@@ -907,6 +974,32 @@ def fetch_stats(endpoint: str, timeout: float = 10.0) -> StatsSnapshot:
     return StatsSnapshot(control_probe(endpoint, MsgType.Control_Stats,
                                        MsgType.Control_Reply_Stats,
                                        timeout=timeout, what="stats"))
+
+
+def fetch_digest(endpoint: str, timeout: float = 30.0) -> Dict[str, Any]:
+    """One-shot state-digest probe: ``{"role", "endpoint", "watermark",
+    "layout_version", "tables": {tid: {"digest", "rows"}}}`` from any
+    serving process — primary, replica, or standby serving reads —
+    computed under its dispatcher seam so the (digest, watermark) pair
+    is exact. Slot-free. The fleet auditor (obs/audit.py) compares
+    these across roles at a common watermark."""
+    return control_probe(endpoint, MsgType.Control_Digest,
+                         MsgType.Control_Reply_Digest,
+                         timeout=timeout, what="digest")
+
+
+def fetch_cut(endpoint: str, cut_id: str, timeout: float = 120.0,
+              kill: str = "") -> Dict[str, Any]:
+    """One-shot consistent-cut marker: ask a shard primary to snapshot
+    every table at its WAL fence into ``cut_<cut_id>/`` and reply
+    ``{"cut_id", "fence", "segment", "cut_dir", "digests", "tables",
+    "dedup_count"}``. ``kill="shard"`` rides the payload for the
+    MV_CUT_KILL chaos drill (the shard dies after its snapshot, before
+    replying — the coordinator must fail the whole cut)."""
+    return control_probe(endpoint, MsgType.Control_Cut,
+                         MsgType.Control_Reply_Cut, timeout=timeout,
+                         what="cut", payload={"cut_id": str(cut_id),
+                                              "kill": kill or ""})
 
 
 # -- client side -------------------------------------------------------------

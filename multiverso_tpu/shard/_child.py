@@ -20,6 +20,25 @@ standby) and announces a takeover via ``standby<k>.tookover``.
 ``--recover`` replays this shard's WAL before serving — the per-shard
 restart-recovery path (docs/fault_tolerance.md §7, per shard).
 
+``--restore-cut <cut_dir>`` loads this shard's slice of a committed
+consistent cut (durable/cut.py) before serving: every table restored to
+the state at the cut's WAL fence, and the dedup window seeded from the
+cut's acked-Add ledger so clients retrying pre-cut Adds are answered,
+not double-applied. The point-in-time-recovery bring-up vehicle
+(``mv.restore_fleet``).
+
+``--clone-primary <endpoint>`` bootstraps this shard from a LIVE donor
+primary instead: one quiesced ``Control_Replicate`` transfer (tables +
+dedup window + watermark — the same shape a warm standby absorbs), then
+serve under a fresh WAL lineage. The blue/green bring-up vehicle
+(``mv.clone_fleet``).
+
+A replica child honors the ``MV_AUDIT_CORRUPT=<table>:<row>[:<after>]``
+chaos env: once synced and past ``after`` applied records it flips one
+byte of that row IN its applied state — the seeded divergence the fleet
+auditor (obs/audit.py) must catch. Wire-level corruption cannot stage
+this drill: the frame CRC discards a corrupted record before apply.
+
 ``--join <spec.json>`` runs a live-migration JOINER (shard/reshard.py):
 build this member's tables at their NEW-layout spans, absorb a quiesced
 range transfer from each donor and tail its WAL (durable/migrate.py),
@@ -65,6 +84,96 @@ def _build_tables(mv, spec, shard: int):
                          shard, worker.table_id, entry["table_id"])
         workers.append(worker)
     return workers
+
+
+def _restore_from_cut(tables, cut_dir: str) -> None:
+    """Point-in-time recovery (durable/cut.py): load every table's
+    ``cut_<id>/`` snapshot — the state at the cut's WAL fence — and seed
+    the dedup window from the cut's acked-Add ledger, all BEFORE
+    ``serve()``. A client retrying a pre-cut Add against the restored
+    fleet gets its cached ACK, never a second apply."""
+    from multiverso_tpu import checkpoint, io as mv_io, log
+    from multiverso_tpu.durable.cut import CUT_META
+    from multiverso_tpu.runtime.zoo import Zoo
+    with mv_io.get_stream(mv_io.join(cut_dir, CUT_META), "r") as stream:
+        meta = json.loads(bytes(stream.read()).decode("utf-8"))
+    restored = checkpoint.restore_tables(tables, cut_dir)
+    Zoo.instance()._dedup_seeds = [tuple(int(x) for x in entry)
+                                   for entry in meta.get("dedup", [])]
+    log.info("restore-cut: %d table(s) at fence %d from %s (%d dedup "
+             "seed(s))", restored, int(meta.get("fence", -1)), cut_dir,
+             len(meta.get("dedup", [])))
+
+
+def _clone_from_primary(tables, donor: str) -> None:
+    """Blue/green clone (durable/cut.py): absorb ONE quiesced
+    Control_Replicate transfer from a live donor primary — tables, dedup
+    Add-window, watermark, the exact shape a warm standby loads — then
+    fall through to serve() under this shard's own fresh WAL lineage.
+    The probe connection closes after the transfer; the donor drops the
+    dead subscriber on its next WAL send, so the clone never tails."""
+    import numpy as np
+    from multiverso_tpu import config, io as mv_io, log
+    from multiverso_tpu.runtime.message import MsgType
+    from multiverso_tpu.runtime.remote import control_probe
+    from multiverso_tpu.runtime.zoo import Zoo
+    payload = control_probe(
+        donor, MsgType.Control_Replicate, MsgType.Control_Reply_Replicate,
+        timeout=float(config.get_flag("audit_timeout_seconds")),
+        what="clone")
+    by_id = {int(w.table_id): getattr(w, "_server_table", w)
+             for w in tables}
+    server = Zoo.instance().server
+
+    def run():
+        for table_id, blob in payload.get("tables", {}).items():
+            server_table = by_id.get(int(table_id))
+            if server_table is None:
+                log.fatal("clone: donor transfer names unknown table %s — "
+                          "clone with the donor group's layout", table_id)
+            data = bytes(np.ascontiguousarray(
+                np.asarray(blob, dtype=np.uint8)))
+            server_table.load(mv_io.MemoryStream(data))
+
+    if server is not None and hasattr(server, "run_serialized"):
+        server.run_serialized(run)
+    else:
+        run()
+    Zoo.instance()._dedup_seeds = [tuple(int(x) for x in entry)
+                                   for entry in payload.get("dedup", [])]
+    log.info("clone: absorbed %d table(s) from %s at watermark %d",
+             len(payload.get("tables", {})), donor,
+             int(payload.get("watermark", -1)))
+
+
+def _arm_audit_corruption(standby, spec: str) -> None:
+    """MV_AUDIT_CORRUPT=<table>:<row>[:<after>] — the seeded-divergence
+    chaos drill: once this replica is synced and has applied ``after``
+    records (default 1), flip one byte of the named row IN its applied
+    state, under the replay-serialized seam. The fleet auditor must
+    catch the divergence within one audit interval."""
+    import threading
+    from multiverso_tpu import log
+    from multiverso_tpu.fault.inject import corrupt_table_row
+    parts = spec.split(":")
+    table_id, row = int(parts[0]), int(parts[1])
+    after = int(parts[2]) if len(parts) > 2 else 1
+
+    def drill() -> None:
+        standby.synced.wait(120.0)
+        deadline = time.monotonic() + 120.0
+        while (standby.records_applied < after
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        table = standby._tables.get(table_id)
+        if table is None:
+            log.error("audit-corrupt drill: no table %d on this replica",
+                      table_id)
+            return
+        standby._run(lambda: corrupt_table_row(table, row))
+
+    threading.Thread(target=drill, daemon=True,
+                     name="mv-audit-corrupt-drill").start()
 
 
 def _run_join(join_path: str) -> int:
@@ -168,6 +277,13 @@ def main(argv=None) -> int:
                         help="this replica also holds the failover role")
     parser.add_argument("--primary", default="")
     parser.add_argument("--recover", action="store_true")
+    parser.add_argument("--restore-cut", default="",
+                        help="restore this shard from a consistent-cut "
+                             "snapshot directory before serving (PITR)")
+    parser.add_argument("--clone-primary", default="",
+                        help="bootstrap this shard's state from a live "
+                             "donor primary via Control_Replicate "
+                             "(blue/green clone)")
     parser.add_argument("--port", type=int, default=0)
     args = parser.parse_args(argv)
     if args.join:
@@ -219,6 +335,9 @@ def main(argv=None) -> int:
             f"{spec.get('host', '127.0.0.1')}:0")
         _write_atomic(os.path.join(
             base_dir, f"replica{shard}.{args.replica}.endpoint"), read_ep)
+        corrupt = os.environ.get("MV_AUDIT_CORRUPT", "")
+        if corrupt:
+            _arm_audit_corruption(standby, corrupt)
         if args.takeover:
             standby.took_over.wait()
             remote = Zoo.instance().remote_server
@@ -230,6 +349,10 @@ def main(argv=None) -> int:
     else:
         if args.recover:
             mv.durable_recover(tables)
+        if args.restore_cut:
+            _restore_from_cut(tables, args.restore_cut)
+        elif args.clone_primary:
+            _clone_from_primary(tables, args.clone_primary)
         endpoint = mv.serve(f"{spec.get('host', '127.0.0.1')}:{args.port}")
         remote = Zoo.instance().remote_server
         remote.layout_path = spec.get("layout_path", "")

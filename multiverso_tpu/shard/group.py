@@ -46,7 +46,8 @@ class ShardGroup:
                  durable: Optional[bool] = None,
                  partitioner: Optional[str] = None,
                  flags: Optional[Dict[str, Any]] = None,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 preplanned: bool = False) -> None:
         if shards is None:
             shards = int(config.get_flag("shards"))
         if shards < 1:
@@ -67,10 +68,16 @@ class ShardGroup:
         # standby/replica replication tails the WAL — durability is implied
         self.durable = (bool(durable) if durable is not None
                         else (self.standby or self.num_replicas > 0))
-        part_flag = validate_partitioner_flag(
-            partitioner if partitioner is not None
-            else config.get_flag("shard_partitioner"))
-        self.entries = plan_tables(tables, self.num_shards, part_flag)
+        if preplanned:
+            # tables are already per-shard plan entries (a cut manifest's
+            # or a source group's layout) — replanning could change the
+            # partition and misalign every restored/cloned shard snapshot
+            self.entries = [dict(e) for e in tables]
+        else:
+            part_flag = validate_partitioner_flag(
+                partitioner if partitioner is not None
+                else config.get_flag("shard_partitioner"))
+            self.entries = plan_tables(tables, self.num_shards, part_flag)
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="mv_shards_")
         os.makedirs(self.base_dir, exist_ok=True)
         self.host = host
@@ -88,6 +95,11 @@ class ShardGroup:
         # running FENCED — serving Reply_WrongShard to stale clients —
         # until the group stops
         self._retired_procs: List[subprocess.Popen] = []
+        # extra child argv per primary shard — the PITR/clone bring-up
+        # vehicle (durable/cut.py): restore_fleet appends
+        # ["--restore-cut", <cut_dir>], clone_fleet
+        # ["--clone-primary", <endpoint>]
+        self._primary_extra: Dict[int, List[str]] = {}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self, timeout: float = 240.0) -> "ShardGroup":
@@ -152,6 +164,8 @@ class ShardGroup:
             argv += ["--replica", str(replica_index), "--primary", primary]
             if takeover:
                 argv += ["--takeover"]
+        else:
+            argv += self._primary_extra.get(shard, [])
         env = dict(os.environ)
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))

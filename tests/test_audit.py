@@ -1,0 +1,264 @@
+"""Fleet integrity plane, unit tier (obs/audit.py): order-independent
+state digests across every table kind and the tiered↔plain interchange
+(cold rows folded WITHOUT promotion), the continuous FleetAuditor's
+divergence/skew/unreachable/conservation verdicts against an injected
+probe, and the satellite guarantee that observability fan-outs
+(``mv.stats_all``, ``mv.attribution``, ``fetch_profile``) degrade to
+partial views — never raise — against fenced or dead members. The live
+cut/restore/clone drills are tests/test_cut.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.io import MemoryStream
+from multiverso_tpu.obs.audit import (FleetAuditor, digest_payload,
+                                      table_digest)
+from multiverso_tpu.tables.kv_table import KVServer, TieredKVServer
+from multiverso_tpu.tables.sparse_table import (SparseFTRLServer,
+                                                SparseServer,
+                                                TieredSparseServer)
+
+SEED = int(os.environ.get("MV_FAULT_SEED", "0"))
+
+
+# -- digests ------------------------------------------------------------------
+
+def test_sparse_digest_order_independent_and_content_sensitive():
+    """Two servers holding the SAME rows inserted in different orders
+    digest equal; flipping one element changes the digest; row count
+    rides the digest (an empty table != a table of zero rows at key 7)."""
+    a, b = SparseServer(1000, width=2), SparseServer(1000, width=2)
+    keys = np.array([3, 700, 41, 12], np.int64)
+    vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+    a.process_add((keys, vals, None))
+    for i in np.random.default_rng(SEED).permutation(4):
+        b.process_add((keys[i:i + 1], vals[i:i + 1], None))
+    assert table_digest(a) == table_digest(b)
+    assert table_digest(a)["rows"] == 4
+
+    b.process_add((keys[:1], np.float32([[1e-3, 0]]), None))
+    assert table_digest(a)["digest"] != table_digest(b)["digest"]
+
+    empty = SparseServer(1000, width=2)
+    zero_row = SparseServer(1000, width=2)
+    zero_row.process_add((np.array([7], np.int64),
+                          np.zeros((1, 2), np.float32), None))
+    assert table_digest(empty)["digest"] != table_digest(zero_row)["digest"]
+
+
+def test_tiered_digest_folds_cold_rows_without_promotion(tmp_path):
+    """The acceptance property: a tiered table digests equal to a plain
+    table loaded from its snapshot, and digesting folds the cold segments
+    in place — TIER_PROMOTIONS stays flat and the cold tier keeps its
+    rows (an audit must not blow away the working set)."""
+    tiered = TieredSparseServer(10_000, width=4,
+                                resident_bytes=4 * 4 * 4, cold_bits=0,
+                                tier_dir=str(tmp_path / "tier"))
+    rng = np.random.default_rng(SEED)
+    keys = rng.choice(10_000, 40, replace=False).astype(np.int64)
+    vals = rng.normal(0, 1, (40, 4)).astype(np.float32)
+    tiered.process_add((keys, vals, None))
+    tiered._tier.maintain()
+    assert tiered.tier_stats()["cold_rows"] > 0
+
+    promotions = Dashboard.counter_value("TIER_PROMOTIONS")
+    cold_before = tiered.tier_stats()["cold_rows"]
+    tiered_digest = table_digest(tiered)
+    assert Dashboard.counter_value("TIER_PROMOTIONS") == promotions
+    assert tiered.tier_stats()["cold_rows"] == cold_before
+
+    buf = MemoryStream()
+    tiered.store(buf)
+    buf.seek(0)
+    plain = SparseServer(10_000, width=4)
+    plain.load(buf)
+    assert table_digest(plain) == tiered_digest
+    tiered._tier.close()
+
+
+def test_digest_covers_ftrl_kv_tiered_kv_and_dense_kinds(tmp_path):
+    """Every server kind digests, and distinct states digest apart."""
+    ftrl = SparseFTRLServer(100, width=2)
+    ftrl.process_add((np.array([5], np.int64),
+                      np.float32([[0.5, -0.5]]), None))
+    d1 = table_digest(ftrl)
+    ftrl.process_add((np.array([5], np.int64),
+                      np.float32([[0.1, 0.1]]), None))
+    assert table_digest(ftrl)["digest"] != d1["digest"]
+
+    kv = KVServer(value_dtype=np.float32)
+    kv.process_add(([3, 9], [10.0, 20.0], None))
+    tkv = TieredKVServer(value_dtype=np.float32, cold_bits=0,
+                         resident_bytes=4, tier_dir=str(tmp_path / "kv"))
+    tkv.process_add(([3, 9], [10.0, 20.0], None))
+    tkv._tier.maintain()
+    # plain and tiered KV twins applying the same stream digest equal
+    assert table_digest(kv) == table_digest(tkv)
+    tkv._tier.close()
+
+
+def test_digest_dense_kind_via_store_fallback(mv_env):
+    """Dense kinds fold their canonical store() stream as one pseudo-row:
+    still process-stable and content-sensitive."""
+    t = mv.create_table("array", 8, np.float32)
+    d_zero = table_digest(t)
+    assert d_zero["rows"] == 1
+    t.add(np.ones(8, np.float32))
+    assert table_digest(t)["digest"] != d_zero["digest"]
+
+
+def test_digest_payload_shape():
+    t = SparseServer(10, width=1)
+    payload = digest_payload({0: t}, role="primary", endpoint="x:1",
+                             watermark=7, layout_version=2)
+    assert payload["role"] == "primary" and payload["watermark"] == 7
+    assert payload["layout_version"] == 2
+    assert set(payload["tables"][0]) == {"digest", "rows"}
+    json.dumps(payload)  # wire/manifest safe
+
+
+# -- the auditor against an injected probe ------------------------------------
+
+def _payload(ep, role, wm, lv=1, digest="aaaa", rows=3):
+    return {"role": role, "endpoint": ep, "watermark": wm,
+            "layout_version": lv,
+            "tables": {0: {"digest": digest, "rows": rows}}}
+
+
+class _FakeFleet:
+    endpoints = ["p0:1"]
+    replica_endpoints = [["r0:1"]]
+    base_dir = ""
+
+
+def test_auditor_divergence_fires_metric_and_manifest_flight_dump(tmp_path):
+    """A replica answering a DIFFERENT digest at the primary's watermark
+    is divergence: AUDIT_DIVERGENCE counts, the report names both
+    digests + the watermark, and ONE manifest-carrying flight dump fires
+    (edge-triggered — a persisting divergence must not flood the
+    recorder)."""
+    path = str(tmp_path / "flight.jsonl")
+    mv.set_flag("flight_recorder_path", path)
+
+    def probe(ep, timeout):
+        role = "primary" if ep.startswith("p") else "replica"
+        return _payload(ep, role, wm=10,
+                        digest="aaaa" if role == "primary" else "bbbb")
+
+    auditor = FleetAuditor(_FakeFleet(), interval=0, probe=probe,
+                           manifest={"cut_id": "c1", "layout_version": 1})
+    report = auditor.check()
+    assert not report["ok"] and len(report["divergences"]) == 1
+    div = report["divergences"][0]
+    assert div["kind"] == "digest_mismatch" and div["watermark"] == 10
+    assert div["primary"]["digest"] == "aaaa"
+    assert div["replica"]["digest"] == "bbbb"
+    assert Dashboard.counter_value("AUDIT_DIVERGENCE") == 1
+    assert Dashboard.counter_value("AUDIT_RUNS") == 1
+
+    auditor.check()  # still diverged: counts again, does NOT re-dump
+    assert Dashboard.counter_value("AUDIT_DIVERGENCE") == 2
+    with open(path, encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()]
+    events = [e for e in events if e.get("kind") == "event"]
+    assert len(events) == 1
+    assert events[0]["reason"] == "audit_divergence"
+    assert events[0]["manifest"]["cut_id"] == "c1"
+    assert events[0]["watermarks"]
+
+
+def test_auditor_skew_and_unreachable_are_not_divergence():
+    """A lagging replica (different watermark) is skew — digests of
+    different prefixes are incomparable; a dead replica is unreachable.
+    Neither is divergence."""
+    def probe(ep, timeout):
+        if ep.startswith("r"):
+            if ep == "r0:1":
+                raise ConnectionError("dead")
+            return _payload(ep, "replica", wm=8, digest="zzzz")
+        return _payload(ep, "primary", wm=10)
+
+    fleet = {"endpoints": ["p0:1"], "replicas": [["r0:1", "r1:1"]]}
+    auditor = FleetAuditor(fleet, interval=0, probe=probe)
+    report = auditor.check()
+    assert report["ok"]
+    assert report["unreachable"] == ["r0:1"] and report["skews"] == 1
+    assert Dashboard.counter_value("AUDIT_SKEW_SKIPS") == 1
+    assert Dashboard.counter_value("AUDIT_UNREACHABLE") == 1
+
+
+def test_auditor_conservation_ledger_catches_watermark_regression():
+    """Within one layout version a member's watermark must never move
+    backwards — acked records vanishing is loss. A layout-version bump
+    (migration fence) legitimately resets the lineage."""
+    wms = iter([10, 4, 4])
+    lvs = iter([1, 1, 2])
+
+    def probe(ep, timeout):
+        return _payload(ep, "primary", wm=next(wms), lv=next(lvs))
+
+    auditor = FleetAuditor(["p0:1"], interval=0, probe=probe)
+    assert auditor.check()["ok"]
+    report = auditor.check()  # wm 10 -> 4 under the same layout: loss
+    kinds = [d["kind"] for d in report["divergences"]]
+    assert kinds == ["watermark_regression"]
+    assert auditor.check()["ok"]  # wm 4 again but lv bumped: clean slate
+
+
+def test_auditor_background_mode_sweeps(tmp_path):
+    """mv.audit with an interval runs sweeps on its own thread."""
+    calls = []
+
+    def probe(ep, timeout):
+        calls.append(ep)
+        return _payload(ep, "primary", wm=1)
+
+    auditor = FleetAuditor(["p0:1"], interval=0.05, probe=probe).start()
+    try:
+        # a role-less process running a background auditor is stamped
+        # with the "auditor" Prometheus role label
+        assert Dashboard.identity().get("role") == "auditor"
+        import time
+        deadline = time.monotonic() + 5.0
+        while len(calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(calls) >= 2
+        assert auditor.last_report is not None
+    finally:
+        auditor.stop()
+
+
+# -- satellite: probes degrade against fenced / dead members ------------------
+
+def test_probes_degrade_against_fenced_donor_and_dead_member():
+    """A fenced retired donor (layout_version bumped post-cutover — it
+    refuses data traffic with Reply_WrongShard) must still answer every
+    control probe: stats, profile, traces, digest, attribution. A dead
+    endpoint lands on the partial/unreachable view — never an
+    exception."""
+    from multiverso_tpu.runtime.remote import fetch_digest, fetch_profile
+    from multiverso_tpu.runtime.zoo import Zoo
+    mv.init(remote_workers=1)
+    mv.create_table("array", 8, np.float32)
+    endpoint = mv.serve("127.0.0.1:0")
+    # fence: what a retired donor looks like after a migration cutover
+    Zoo.instance().remote_server.layout_version = 5
+
+    assert fetch_profile(endpoint, timeout=10.0)["role"] == "primary"
+    assert fetch_digest(endpoint, timeout=10.0)["layout_version"] == 5
+    report = mv.attribution([endpoint], timeout=5.0)
+    assert report is not None  # degrades to empty, never raises
+
+    dead = "127.0.0.1:1"  # nothing listens on the reserved port
+    merged = mv.stats_all([endpoint, dead], timeout=3.0)
+    assert merged.unreachable == [dead]
+    report = mv.attribution([endpoint, dead], timeout=3.0)
+    assert report is not None
+    with pytest.raises((OSError, RuntimeError)):
+        fetch_profile(dead, timeout=1.0)  # single-endpoint probe raises
+    mv.shutdown()

@@ -1,0 +1,262 @@
+"""Watermark-consistent fleet cuts + PITR/clone (durable/cut.py), live
+over real shard processes:
+
+* cut → restore roundtrip on a 2-shard fleet: the restored fleet serves
+  EXACTLY the state at the cut (post-cut writes gone), and every
+  restored primary's content digest matches the manifest's per-shard
+  digests — the acceptance equality the integrity plane is built on;
+* clone of a LIVE serving fleet digests equal to its source;
+* replica-corruption drill: one flipped byte of applied replica state is
+  caught by the background auditor within ~one interval, firing
+  AUDIT_DIVERGENCE with a manifest-carrying flight dump;
+* MV_CUT_KILL chaos arms (self-skipping; the CI audit matrix sets the
+  env): a shard or the coordinator dying mid-cut fails that cut, leaves
+  the PREVIOUS manifest as the recovery point, and restoring it loses
+  zero acked Adds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.durable.cut import load_cut_manifest
+from multiverso_tpu.runtime.remote import fetch_digest
+from multiverso_tpu.shard.group import ShardGroup
+
+GROUP_FLAGS = {"remote_workers": 4, "heartbeat_seconds": 0.2,
+               "lease_seconds": 1.5, "request_retry_seconds": 1.0,
+               "reconnect_deadline_seconds": 30.0}
+
+TABLES = [{"kind": "sparse", "key_space": 1000, "width": 2},
+          {"kind": "kv", "value_dtype": "<i8"}]
+
+
+def _digests(tables):
+    """JSON/wire roundtrips stringify int table ids — normalize before
+    comparing a live digest against a manifest's."""
+    return {int(k): dict(v) for k, v in tables.items()}
+
+
+def _repo_env():
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return env
+
+
+# -- cut + restore ------------------------------------------------------------
+
+def test_cut_restore_roundtrip_two_shards(tmp_path, monkeypatch):
+    """Acked Adds before the cut survive a full fleet teardown + PITR;
+    Adds after the cut do not leak in; restored digests == manifest
+    digests per shard."""
+    monkeypatch.delenv("MV_CUT_KILL", raising=False)  # clean leg, even in
+    # the CI chaos matrix where the env arms the dedicated kill tests
+    with ShardGroup(TABLES, shards=2, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        sp, kv = client.tables()
+        keys = np.array([3, 500, 41, 999], np.int64)  # spans both shards
+        vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+        sp.add(keys, vals)
+        kv.add([5, 700], [11, 22])
+
+        manifest = mv.cut_fleet(group, cut_id="roundtrip")
+        assert manifest["cut_id"] == "roundtrip"
+        assert len(manifest["shards"]) == 2
+        assert set(manifest["watermarks"]) == set(group.endpoints)
+        assert load_cut_manifest(group)["cut_id"] == "roundtrip"
+        assert Dashboard.counter_value("CUT_FLEET_COMMITS") == 1
+
+        # post-cut writes: present live, absent after PITR
+        sp.add(keys, vals)
+        kv.add([5, 31337], [100, 9])
+        np.testing.assert_array_equal(sp.get(keys), 2 * vals)
+
+    restored = mv.restore_fleet(group.base_dir,
+                                base_dir=str(tmp_path / "restored"))
+    try:
+        client = restored.connect()
+        sp, kv = client.tables()
+        np.testing.assert_array_equal(sp.get(keys), vals)  # state AT cut
+        assert kv.get([5, 700, 31337]) == [11, 22, 0]
+        # digest equality, shard by shard, against the committed manifest
+        for shard in load_cut_manifest(group)["shards"]:
+            live = fetch_digest(restored.endpoints[int(shard["shard"])],
+                                timeout=30.0)
+            assert _digests(live["tables"]) == _digests(shard["digests"])
+    finally:
+        restored.stop()
+
+
+def test_clone_fleet_digests_equal_source(tmp_path):
+    """A live clone serves the source's exact state: digests equal at a
+    quiesced moment, reads match."""
+    with ShardGroup(TABLES, shards=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        sp, kv = client.tables()
+        sp.add(np.array([7, 77], np.int64), np.ones((2, 2), np.float32))
+        kv.add([1, 2], [10, 20])
+
+        clone = mv.clone_fleet(group, base_dir=str(tmp_path / "clone"))
+        try:
+            src = fetch_digest(group.endpoints[0], timeout=30.0)
+            dup = fetch_digest(clone.endpoints[0], timeout=30.0)
+            assert _digests(src["tables"]) == _digests(dup["tables"])
+            csp, ckv = clone.connect().tables()
+            np.testing.assert_array_equal(
+                csp.get(np.array([7, 77], np.int64)),
+                np.ones((2, 2), np.float32))
+            assert ckv.get([1, 2]) == [10, 20]
+        finally:
+            clone.stop()
+
+
+# -- the replica-corruption audit drill ---------------------------------------
+
+def test_auditor_catches_corrupted_replica(tmp_path, monkeypatch):
+    """One byte of a replica's APPLIED state flips (the MV_AUDIT_CORRUPT
+    in-process drill — wire corruption is CRC-discarded and degrades to
+    a drop, so applied divergence needs this seam). The background
+    auditor must fire AUDIT_DIVERGENCE within ~one interval, with a
+    manifest-carrying flight dump."""
+    flight = str(tmp_path / "flight.jsonl")
+    mv.set_flag("flight_recorder_path", flight)
+    monkeypatch.setenv("MV_AUDIT_CORRUPT", "0:7:2")  # table 0 row 7 after 2
+    with ShardGroup([{"kind": "sparse", "key_space": 100, "width": 2}],
+                    shards=1, replicas=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        monkeypatch.delenv("MV_AUDIT_CORRUPT")  # children already armed
+        client = group.connect()
+        (sp,) = client.tables()
+        sp.add(np.array([7], np.int64), np.ones((1, 2), np.float32))
+        sp.add(np.array([9], np.int64), np.ones((1, 2), np.float32))
+
+        # wait for the replica to catch up to the primary's watermark
+        deadline = time.monotonic() + 60.0
+        primary_wm = fetch_digest(group.endpoints[0], timeout=30.0)[
+            "watermark"]
+        while time.monotonic() < deadline:
+            if fetch_digest(group.replica_endpoints[0][0],
+                            timeout=30.0)["watermark"] >= primary_wm:
+                break
+            time.sleep(0.1)
+
+        auditor = mv.audit(group, interval=0.2,
+                           manifest={"cut_id": "drill", "layout_version": 1})
+        try:
+            deadline = time.monotonic() + 30.0
+            while (Dashboard.counter_value("AUDIT_DIVERGENCE") == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert Dashboard.counter_value("AUDIT_DIVERGENCE") > 0
+            report = auditor.last_report
+            assert report is not None and not report["ok"]
+            div = report["divergences"][0]
+            assert div["kind"] == "digest_mismatch"
+        finally:
+            auditor.stop()
+    with open(flight, encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()]
+    events = [e for e in events if e.get("kind") == "event"
+              and e.get("reason") == "audit_divergence"]
+    assert events and events[0]["manifest"]["cut_id"] == "drill"
+
+    art_dir = os.environ.get("MV_CHAOS_ARTIFACT_DIR")
+    if art_dir:  # CI post-mortem: the divergence report + flight dump
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "audit-report.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1)
+        import shutil
+        shutil.copy(flight, os.path.join(art_dir,
+                                         "audit-divergence-flight.jsonl"))
+
+
+# -- MV_CUT_KILL chaos arms (CI audit matrix) ---------------------------------
+
+@pytest.mark.skipif(os.environ.get("MV_CUT_KILL") != "shard",
+                    reason="chaos arm: needs MV_CUT_KILL=shard")
+def test_kill_shard_mid_cut_previous_manifest_survives(tmp_path,
+                                                       monkeypatch):
+    """A shard dying after its snapshot but before replying fails the
+    whole cut; LATEST stays the previous committed cut; restoring it
+    loses zero acked Adds."""
+    monkeypatch.delenv("MV_CUT_KILL")
+    with ShardGroup([{"kind": "sparse", "key_space": 100, "width": 2}],
+                    shards=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        (sp,) = client.tables()
+        keys = np.array([1, 50], np.int64)
+        vals = np.ones((2, 2), np.float32)
+        sp.add(keys, vals)
+        mv.cut_fleet(group, cut_id="clean")  # committed recovery point
+
+        monkeypatch.setenv("MV_CUT_KILL", "shard")
+        with pytest.raises(RuntimeError, match="previous manifest"):
+            mv.cut_fleet(group, cut_id="doomed", timeout=20.0)
+        assert Dashboard.counter_value("CUT_FLEET_FAILURES") == 1
+        assert load_cut_manifest(group)["cut_id"] == "clean"
+        monkeypatch.delenv("MV_CUT_KILL")
+
+    restored = mv.restore_fleet(group.base_dir,
+                                base_dir=str(tmp_path / "restored"))
+    try:
+        (sp,) = restored.connect().tables()
+        np.testing.assert_array_equal(sp.get(keys), vals)  # zero Add loss
+    finally:
+        restored.stop()
+
+
+@pytest.mark.skipif(os.environ.get("MV_CUT_KILL") != "coordinator",
+                    reason="chaos arm: needs MV_CUT_KILL=coordinator")
+def test_kill_coordinator_mid_cut_previous_manifest_survives(tmp_path,
+                                                             monkeypatch):
+    """The coordinator dying after the fan-out but before the manifest
+    commit leaves no trace of the doomed cut: LATEST stays the previous
+    cut and PITR restores it intact."""
+    monkeypatch.delenv("MV_CUT_KILL")
+    with ShardGroup([{"kind": "sparse", "key_space": 100, "width": 2}],
+                    shards=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        (sp,) = client.tables()
+        keys = np.array([1, 50], np.int64)
+        vals = np.ones((2, 2), np.float32)
+        sp.add(keys, vals)
+        mv.cut_fleet(group, cut_id="clean")
+
+        # the doomed cut runs in a subprocess: MV_CUT_KILL=coordinator
+        # SIGKILLs the whole coordinating interpreter pre-commit
+        env = _repo_env()
+        env["MV_CUT_KILL"] = "coordinator"
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import multiverso_tpu as mv; "
+             f"mv.cut_fleet({group.base_dir!r}, cut_id='doomed')"],
+            env=env, timeout=120, capture_output=True)
+        assert proc.returncode == -9, proc.stderr.decode()[-2000:]
+        assert load_cut_manifest(group)["cut_id"] == "clean"
+
+    restored = mv.restore_fleet(group.base_dir,
+                                base_dir=str(tmp_path / "restored"))
+    try:
+        (sp,) = restored.connect().tables()
+        np.testing.assert_array_equal(sp.get(keys), vals)
+    finally:
+        restored.stop()

@@ -1,0 +1,132 @@
+"""Direct unit tier for durable/migrate.py's RangeTailer — the
+gap-detect → resubscribe → fresh-transfer recovery path, without a live
+donor: the tailer is constructed standalone (``zoo.server=None`` inlines
+its dispatcher seam) with a recording fake transport, and the
+replication stream is injected as crafted ``Control_Wal_Record`` /
+``Control_Reply_Migrate`` frames. Pins exactly the scenario the shard
+reshard chaos runs rely on: a dropped WAL record is detected as a
+sequence gap, answered by a FRESH range transfer (absorb_range is
+idempotent), raced records replay only past the transfer watermark, and
+duplicates never double-apply."""
+
+from types import SimpleNamespace
+
+import numpy as np
+
+from multiverso_tpu.dashboard import Dashboard
+from multiverso_tpu.durable.migrate import RangeTailer
+from multiverso_tpu.runtime import wire
+from multiverso_tpu.runtime.message import Message, MsgType
+
+
+class _FakeNet:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def subscribes(self):
+        return [m for m in self.sent
+                if m.type == MsgType.Control_Migrate]
+
+
+class _FakeTable:
+    def __init__(self):
+        self.absorbed = []
+        self.adds = []
+
+    def absorb_range(self, start, values):
+        self.absorbed.append((start, np.asarray(values).copy()))
+
+    def process_add(self, request):
+        self.adds.append(request)
+
+
+def _tailer():
+    table = _FakeTable()
+    spec = {"table_id": 0, "server_table": table, "kind": "matrix",
+            "donor_lo": 4, "donor_hi": 8, "rcpt_start": 0,
+            "rcpt_size": 0, "num_col": 2}
+    tailer = RangeTailer("fake:0", [spec],
+                         zoo=SimpleNamespace(server=None),
+                         lease_seconds=30.0)
+    tailer._net = _FakeNet()
+    return tailer, table
+
+
+def _record(seq, row=5):
+    request = (np.array([row], np.int32),
+               np.full((1, 2), float(seq), np.float32), None)
+    return Message(src=0, dst=-1, type=MsgType.Control_Wal_Record,
+                   table_id=0, msg_id=seq, watermark=seq,
+                   data=wire.encode(request))
+
+
+def _transfer(tailer, watermark):
+    # mimic the pump's Control_Reply_Migrate handling: the flag clears
+    # BEFORE the transfer loads, then the raced backlog replays
+    tailer._awaiting_transfer = False
+    tailer._load_transfer({"tables": {0: np.zeros((4, 2), np.float32)},
+                           "watermark": watermark})
+
+
+def test_gap_detect_resubscribes_and_fresh_transfer_resyncs():
+    """A dropped record shows up as seq jumping received_watermark+2:
+    the tailer counts MIGRATION_GAP_RESYNCS, clears its raced buffer,
+    sends a fresh Control_Migrate subscribe, and buffers the stream
+    until the new transfer lands — after which only records past the
+    transfer watermark replay."""
+    tailer, table = _tailer()
+    tailer._awaiting_transfer = True
+    _transfer(tailer, watermark=5)
+    assert tailer.synced.is_set()
+    assert len(table.absorbed) == 1 and table.absorbed[0][0] == 0
+    tailer._on_record(_record(6))
+    assert tailer.applied_watermark == 6 and len(table.adds) == 1
+
+    tailer._on_record(_record(8))  # record 7 was dropped on the wire
+    assert Dashboard.counter_value("MIGRATION_GAP_RESYNCS") == 1
+    assert tailer._awaiting_transfer
+    assert len(tailer._net.subscribes()) == 1
+    sub = wire.decode(tailer._net.subscribes()[0].data)
+    assert sub["tables"] == {0: [4, 8]}  # the full migrating range, again
+
+    # stream keeps flowing while the fresh transfer is in flight: records
+    # buffer (nothing applies — the local copy has a hole)
+    tailer._on_record(_record(9))
+    tailer._on_record(_record(10))
+    assert len(table.adds) == 1 and len(tailer._pretransfer) == 2
+
+    # the fresh transfer carries watermark 9: the raced suffix (>9)
+    # replays, the rest is already inside the absorbed snapshot
+    _transfer(tailer, watermark=9)
+    assert len(table.absorbed) == 2
+    assert tailer.received_watermark == 10 and tailer.applied_watermark == 10
+    assert len(table.adds) == 2  # only record 10 replayed
+
+
+def test_duplicate_records_never_double_apply():
+    """A retransmitted (<= received) record is dropped, not re-applied."""
+    tailer, table = _tailer()
+    tailer._awaiting_transfer = True
+    _transfer(tailer, watermark=3)
+    tailer._on_record(_record(4))
+    tailer._on_record(_record(4))  # dup
+    tailer._on_record(_record(3))  # stale retransmit from before the cut
+    assert len(table.adds) == 1
+    assert tailer.records_applied == 1
+    assert tailer.received_watermark == 4
+    assert Dashboard.counter_value("MIGRATION_GAP_RESYNCS") == 0
+
+
+def test_out_of_range_records_advance_watermark_only():
+    """Records outside the migrating range still advance the catch-up
+    position (stream position, not payload relevance) without touching
+    the table."""
+    tailer, table = _tailer()
+    tailer._awaiting_transfer = True
+    _transfer(tailer, watermark=0)
+    tailer._on_record(_record(1, row=2))  # donor row 2 < donor_lo=4
+    assert tailer.applied_watermark == 1
+    assert table.adds == [] and tailer.records_applied == 0
