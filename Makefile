@@ -49,6 +49,14 @@
 #                       §8, docs/observability.md §14)
 #   make audit-bench    auditor-overhead A/B + one timed consistent cut
 #                       against a live 2-shard group
+#   make autopilot      fleet-autopilot suite: policy hysteresis/cooldown,
+#                       divergence interlock freeze/ack, Zipf hotspot
+#                       split+replica drill with zero acked-Add loss
+#                       (MV_AUTOPILOT_KILL=before|mid arms the
+#                       kill-mid-action chaos drill; docs/autopilot.md)
+#   make autopilot-bench  Zipf hotspot shift against a live group:
+#                       time-to-split, p99 recovery, acked-Add
+#                       conservation
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -56,9 +64,9 @@ CHAOS_SEED ?= 7
 
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
-	audit audit-bench clean
+	audit audit-bench autopilot autopilot-bench clean
 
-check: lint native test dryrun profile-smoke tiered audit bench
+check: lint native test dryrun profile-smoke tiered audit autopilot bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -129,6 +137,13 @@ audit:
 
 audit-bench:
 	$(CPU_ENV) $(PYTHON) bench.py --audit-bench
+
+autopilot:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_autopilot.py -q \
+		-p no:cacheprovider -p no:randomly
+
+autopilot-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --autopilot-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -1458,6 +1458,139 @@ def bench_tiered(key_space=600_000, width=8, ratio=10, ops=40_000,
         shutil.rmtree(tier_dir, ignore_errors=True)
 
 
+def bench_autopilot(rows=256, cols=16, zipf_s=1.2, tick_interval=0.5,
+                    recover_seconds=2.0, timeout_seconds=45.0):
+    """Fleet-autopilot reaction drill (docs/autopilot.md): a TrafficGen
+    Zipf hotspot lands entirely on shard 0 of a live 2-shard durable
+    group while a background trickle keeps shard 1 warm, and a
+    deterministic ``mv.autopilot`` loop (manual recorder sampling, one
+    ``tick_now`` per ``tick_interval``) reads its own router telemetry
+    and splits the hot shard through the live migration machinery.
+    Reports the wall-clock from hotspot onset to the executed split
+    (``autopilot_time_to_split_seconds``), client Add p99 during the hot
+    window vs after the split (``..p99_hot_ms`` / ``..p99_recovered_ms``
+    — recovery evidence, not a silicon number on this box), and the
+    acked-Add conservation check (mirror equality across the autopilot's
+    topology change; ``autopilot_acked_rows_lost`` must be 0)."""
+    import threading
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.obs.timeseries import TimeSeriesRecorder
+    from multiverso_tpu.shard.group import ShardGroup
+
+    # the drill recipe (tests/test_autopilot.py Zipf drill): one-tick
+    # hysteresis, merges off, thresholds the hot/cold skew clears
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    mv.set_flag("autopilot_window_seconds", 4 * tick_interval)
+    mv.set_flag("reshard_cold_qps", 0.0)
+    mv.set_flag("reshard_min_qps", 1.0)
+    mv.set_flag("reshard_hot_ratio", 2.0)
+
+    recorder = TimeSeriesRecorder(interval=3600.0, samples=64)
+    group = ShardGroup(
+        [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+        shards=2, durable=True, flags={"remote_workers": 4}).start()
+    try:
+        client = group.connect()
+        table = client.table(0)
+        model = np.zeros((rows, cols), np.float32)
+        span = rows // 2                 # shard 0 owns rows [0, span)
+        stop = threading.Event()
+        lock = threading.Lock()
+        lat_ms, lat_lock = [], threading.Lock()
+
+        def hot_writer(seed):
+            # the hotspot: Zipf-skewed keys confined to shard 0's span
+            gen = TrafficGen(span, zipf_s=zipf_s, read_fraction=0.0,
+                             seed=seed)
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                ids = []
+                while len(ids) < 4:
+                    k = gen.draw_key()
+                    if k not in ids:
+                        ids.append(k)
+                ids = np.asarray(ids, np.int32)
+                vals = rng.integers(0, 5, (4, cols)).astype(np.float32)
+                t0 = time.perf_counter()
+                table.add(vals, row_ids=ids)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    model[ids] += vals
+                with lat_lock:
+                    lat_ms.append((time.perf_counter(), dt))
+                time.sleep(0.002)
+
+        def background_writer():
+            # a thin uniform trickle on shard 1 — the cold side of the
+            # hot/cold ratio the detector judges
+            rng = np.random.default_rng(99)
+            vals = np.ones((2, cols), np.float32)
+            while not stop.is_set():
+                ids = rng.choice(np.arange(span, rows), 2,
+                                 replace=False).astype(np.int32)
+                table.add(vals, row_ids=ids)
+                with lock:
+                    model[ids] += vals
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=hot_writer, args=(s,),
+                                    daemon=True) for s in (1, 2)]
+        threads.append(threading.Thread(target=background_writer,
+                                        daemon=True))
+        pilot = mv.autopilot(group, interval=0, recorder=recorder)
+        recorder.sample_now(t=time.time())
+        hot_t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+
+        split_at = ticks = None
+        deadline = hot_t0 + timeout_seconds
+        while time.perf_counter() < deadline:
+            time.sleep(tick_interval)
+            recorder.sample_now(t=time.time())
+            rec = pilot.tick_now(now=time.time())
+            if rec.get("action") == "split" and \
+                    (rec.get("outcome") or {}).get("ok"):
+                split_at = time.perf_counter()
+                ticks = pilot.ticks
+                break
+        if split_at is None:
+            raise RuntimeError("autopilot never split the hot shard "
+                               f"within {timeout_seconds}s")
+
+        time.sleep(recover_seconds)      # traffic on the new layout
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        pilot.stop()
+
+        with lat_lock:
+            hot = [ms for (at, ms) in lat_ms if at <= split_at]
+            recovered = [ms for (at, ms) in lat_ms if at > split_at]
+        final = table.get()
+        lost = int(np.count_nonzero(
+            np.any(final != model, axis=1)))
+        client.close()
+        return {
+            "autopilot_time_to_split_seconds": round(
+                split_at - hot_t0, 3),
+            "autopilot_ticks_to_split": ticks,
+            "autopilot_tick_interval_seconds": tick_interval,
+            "autopilot_zipf_s": zipf_s,
+            "autopilot_shards_after": int(group.num_shards),
+            "autopilot_p99_hot_ms": round(
+                float(np.percentile(hot, 99)), 3) if hot else 0.0,
+            "autopilot_p99_recovered_ms": round(
+                float(np.percentile(recovered, 99)), 3)
+                if recovered else 0.0,
+            "autopilot_hot_adds": len(hot) + len(recovered),
+            "autopilot_acked_rows_lost": lost,
+        }
+    finally:
+        group.stop()
+
+
 def probe_gbps(probe_mb=128):
     """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
     donated-pass loop, min-of-3. ~1s; the load thermometer every gated
@@ -1814,6 +1947,13 @@ if __name__ == "__main__":
         # 10x-over-budget table under Zipf, reports hot-tier hit rate
         print(json.dumps(_single_leg_result(
             {"metric": "tiered_hot_hit_rate", **bench_tiered()})))
+    elif "--autopilot-bench" in sys.argv[1:]:
+        # fleet-autopilot leg only (`make autopilot` drill / operators):
+        # Zipf hotspot shift -> time-to-split, p99 recovery, acked-Add
+        # conservation across the autopilot's own topology change
+        print(json.dumps(_single_leg_result(
+            {"metric": "autopilot_time_to_split_seconds",
+             **bench_autopilot()})))
     elif "--compare" in sys.argv[1:]:
         # regression diff of two result files (CI runs non-blocking)
         sys.exit(_run_compare(sys.argv))

@@ -636,6 +636,32 @@ def audit(fleet, interval: Optional[float] = None,
     return auditor
 
 
+def autopilot(group, interval: Optional[float] = None,
+              auditor: Any = None, **kwargs: Any):
+    """The fleet autopilot (multiverso_tpu/autopilot/): a periodic
+    control loop over a live :class:`~multiverso_tpu.shard.group.
+    ShardGroup` that reads the telemetry plane — per-shard heat,
+    read-tier pressure, replica lag, tier hit rates, the SLO burn
+    engine — and reshapes the fleet through the existing crash-safe
+    machinery: hot-shard splits / cold-range merges via the
+    MigrationCoordinator, live replica add/remove, tier budget
+    rebalance. Safety first: pass the running ``mv.audit`` auditor as
+    ``auditor`` and any ``AUDIT_DIVERGENCE`` freezes the loop until an
+    operator ``.ack()``; every decision (and its rejected alternatives)
+    lands in the flight recorder. Returns a
+    :class:`~multiverso_tpu.autopilot.Autopilot` — already ticking in
+    the background when ``interval`` (or the
+    ``autopilot_interval_seconds`` flag) is > 0; call ``.tick_now()``
+    yourself for deterministic drills, ``.status()`` for the operator
+    view, ``.stop()`` to halt (docs/autopilot.md)."""
+    # the multiverso_tpu.autopilot PACKAGE shares this name: importing it
+    # rebinds the attribute to the module, which is callable with these
+    # exact semantics (autopilot/__init__.py) — delegate so both the
+    # pre-import function and the post-import module behave identically
+    import multiverso_tpu.autopilot as _ap
+    return _ap(group, interval=interval, auditor=auditor, **kwargs)
+
+
 def cut_fleet(fleet, cut_id: Optional[str] = None,
               timeout: Optional[float] = None) -> Dict[str, Any]:
     """Take a watermark-consistent cut of a serving fleet

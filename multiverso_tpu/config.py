@@ -430,6 +430,54 @@ define_double("reshard_min_qps", 50.0,
               "hot-range detector floor: shards below this request rate "
               "never propose a split regardless of skew (splitting an "
               "idle group is churn, not balance)")
+define_double("reshard_cold_qps", 5.0,
+              "cold-range detector ceiling: two ADJACENT shards both "
+              "below this request rate propose a merge (the inverse of "
+              "the split path — an over-split group wastes processes)")
+# Fleet autopilot (multiverso_tpu/autopilot/): the control loop that
+# reads the telemetry plane and reshapes the fleet (docs/autopilot.md).
+define_double("autopilot_interval_seconds", 5.0,
+              "autopilot control-loop tick period; <= 0 disables the "
+              "background thread (tick_now() still works for drills)")
+define_int("autopilot_hysteresis_ticks", 2,
+           "consecutive ticks a condition must hold before the autopilot "
+           "acts on it — one noisy sample must not resize the fleet")
+define_double("autopilot_cooldown_seconds", 60.0,
+              "per-action cooldown after the autopilot executes (or "
+              "fails) an action of that kind; re-deciding inside the "
+              "window is recorded as a rejected alternative")
+define_double("autopilot_window_seconds", 30.0,
+              "observation window the autopilot's sensors read rates "
+              "and per-shard heat over (also the hot-range detector's "
+              "window when the autopilot constructs it)")
+define_int("autopilot_max_replicas", 4,
+           "ceiling on serving read replicas per shard the autopilot "
+           "may scale up to")
+define_int("autopilot_min_replicas", 0,
+           "floor on serving read replicas per shard the autopilot may "
+           "scale down to")
+define_double("autopilot_hedge_rate", 5.0,
+              "read-tier pressure threshold (hedges + refusals + "
+              "primary fallbacks per second): sustained pressure above "
+              "this proposes adding a read replica")
+define_double("autopilot_scaledown_qps", 1.0,
+              "fleet-wide request-rate floor: sustained traffic below "
+              "this proposes removing a read replica (down to "
+              "autopilot_min_replicas)")
+define_double("autopilot_tier_target_hit_rate", 0.90,
+              "tiered-store hot-tier hit-rate target: sustained hit "
+              "rate below this grows the resident budget by "
+              "autopilot_tier_step_bytes (up to autopilot_tier_max_bytes)")
+define_int("autopilot_tier_step_bytes", 16 << 20,
+           "bytes the autopilot grows/shrinks the tier_resident_bytes "
+           "budget by per rebalance action")
+define_int("autopilot_tier_max_bytes", 512 << 20,
+           "ceiling the autopilot may grow tier_resident_bytes to")
+define_bool("autopilot_blue_green", False,
+            "rehearse risky topology changes (split/merge) on an "
+            "mv.clone_fleet canary before executing them live; off, the "
+            "autopilot executes directly through the crash-safe "
+            "MigrationCoordinator path")
 # Read-replica serving tier (durable/standby.py serve loop + runtime/read.py
 # client-side cache and routing; docs/serving.md).
 define_int("replicas", 0,

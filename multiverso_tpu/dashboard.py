@@ -120,6 +120,22 @@ def _prom_name(name: str, suffix: str = "") -> str:
     return f"mvtpu_{base}{suffix}"
 
 
+# per-shard series names (ROUTER_SHARD3_SECONDS, FLEET_SHARD0_REPLICA_LAG)
+# collapse into one labeled Prometheus family: the shard index moves from
+# the metric name into a shard="3" label, so operators aggregate and
+# alert across shards without a regex in every query
+_SHARD_SERIES = re.compile(
+    r"^(?P<pre>.+?)_SHARD(?P<idx>\d+)(?P<post>(?:_[A-Za-z0-9_]+)?)$")
+
+
+def _split_shard(name: str):
+    """``NAME_SHARD<k>_X`` -> (``NAME_X``, "k"); others -> (name, None)."""
+    m = _SHARD_SERIES.match(name)
+    if m is None:
+        return name, None
+    return m.group("pre") + m.group("post"), m.group("idx")
+
+
 def _prom_escape(value: str) -> str:
     """Label-value escaping per the Prometheus text exposition format:
     backslash, double-quote and newline."""
@@ -301,40 +317,62 @@ class Dashboard:
             counters = list(cls._counters.values())
             histograms = list(cls._histograms.values())
             gauges = list(cls._gauges.values())
-        inner = ",".join(f'{k}="{_prom_escape(v)}"'
-                         for k, v in sorted(cls.identity().items()))
-        lab = f"{{{inner}}}" if inner else ""
+        ident = cls.identity()
 
-        def bucket_lab(le: str) -> str:
-            parts = ([inner] if inner else []) + [f'le="{le}"']
-            return "{" + ",".join(parts) + "}"
+        def lab(shard: Optional[str], le: Optional[str] = None) -> str:
+            labels = dict(ident)
+            if shard is not None:
+                # a per-shard series names its OWN shard — it wins over
+                # the process identity (a launcher holding the fleet's
+                # ROUTER_SHARD<k> series has no shard identity anyway)
+                labels["shard"] = shard
+            parts = [f'{k}="{_prom_escape(v)}"'
+                     for k, v in sorted(labels.items())]
+            if le is not None:
+                parts.append(f'le="{le}"')
+            return "{" + ",".join(parts) + "}" if parts else ""
 
-        lines = []
+        lines: list = []
+        typed = set()
+
+        def head(n: str, kind: str) -> None:
+            # one # TYPE line per family — shard-labeled series of one
+            # family share it
+            if n not in typed:
+                typed.add(n)
+                lines.append(f"# TYPE {n} {kind}")
+
         for c in counters:
-            n = _prom_name(c.name)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n}_total{lab} {c.value}")
+            family, shard = _split_shard(c.name)
+            n = _prom_name(family)
+            head(n, "counter")
+            lines.append(f"{n}_total{lab(shard)} {c.value}")
         for g in gauges:
-            n = _prom_name(g.name)
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n}{lab} {g.value:g}")
+            family, shard = _split_shard(g.name)
+            n = _prom_name(family)
+            head(n, "gauge")
+            lines.append(f"{n}{lab(shard)} {g.value:g}")
         for m in monitors:
-            n = _prom_name(m.name)
-            lines.append(f"# TYPE {n}_seconds summary")
-            lines.append(f"{n}_seconds_sum{lab} {m.elapse_ms / 1e3:.9g}")
-            lines.append(f"{n}_seconds_count{lab} {m.count}")
+            family, shard = _split_shard(m.name)
+            n = _prom_name(family)
+            head(f"{n}_seconds", "summary")
+            lines.append(f"{n}_seconds_sum{lab(shard)} "
+                         f"{m.elapse_ms / 1e3:.9g}")
+            lines.append(f"{n}_seconds_count{lab(shard)} {m.count}")
         for h in histograms:
-            n = _prom_name(h.name)
+            family, shard = _split_shard(h.name)
+            n = _prom_name(family)
             data = h.to_dict()
-            lines.append(f"# TYPE {n} histogram")
+            head(n, "histogram")
             cum = 0
             for bound, bucket in zip(data["bounds"], data["buckets"]):
                 cum += bucket
-                lines.append(f'{n}_bucket{bucket_lab(f"{bound:.9g}")} '
-                             f'{cum}')
-            lines.append(f'{n}_bucket{bucket_lab("+Inf")} {data["count"]}')
-            lines.append(f"{n}_sum{lab} {data['sum']:.9g}")
-            lines.append(f"{n}_count{lab} {data['count']}")
+                lines.append(
+                    f'{n}_bucket{lab(shard, le=f"{bound:.9g}")} {cum}')
+            lines.append(f'{n}_bucket{lab(shard, le="+Inf")} '
+                         f'{data["count"]}')
+            lines.append(f"{n}_sum{lab(shard)} {data['sum']:.9g}")
+            lines.append(f"{n}_count{lab(shard)} {data['count']}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     @classmethod

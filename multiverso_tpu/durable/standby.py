@@ -382,9 +382,20 @@ class WarmStandby:
 
     def _lag_gauges(self) -> None:
         """REPLICA_WATERMARK / REPLICA_LAG_RECORDS — the replay-lag
-        telemetry the slot-free stats RPC serves (docs/observability.md)."""
+        telemetry the slot-free stats RPC serves (docs/observability.md).
+        A replica that knows its shard (metrics_shard identity) also
+        publishes the shard-labeled twin, so a merged stats fan-out (and
+        the Prometheus exposition) reads per-shard pressure without
+        joining on endpoint lists."""
+        lag = self.lag_records()
         gauge_set("REPLICA_WATERMARK", max(self.applied_watermark, 0))
-        gauge_set("REPLICA_LAG_RECORDS", self.lag_records())
+        gauge_set("REPLICA_LAG_RECORDS", lag)
+        try:
+            shard = int(config.get_flag("metrics_shard"))
+        except Exception:  # noqa: BLE001 — gauge before flag definition
+            shard = -1
+        if shard >= 0:
+            gauge_set(f"REPLICA_SHARD{shard}_LAG_RECORDS", lag)
 
     # -- failover ------------------------------------------------------------
     def _alive_probe(self) -> bool:

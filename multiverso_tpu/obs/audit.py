@@ -200,6 +200,7 @@ class FleetAuditor:
         self._probe = probe
         self.last_report: Optional[Dict[str, Any]] = None
         self._divergent = False  # edge-trigger state for the flight dump
+        self._divergent_since: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -264,12 +265,33 @@ class FleetAuditor:
                             divergences=divergences,
                             watermarks=[s["watermarks"] for s in shards],
                             manifest=self.manifest)
+            if not self._divergent:
+                self._divergent_since = time.time()
             self._divergent = True
             log.error("audit: %d divergence(s) across the fleet: %r",
                       len(divergences), divergences[:3])
         else:
             self._divergent = False
+            self._divergent_since = None
         return report
+
+    @property
+    def divergent(self) -> bool:
+        """Is the fleet currently diverged (as of the last sweep)? The
+        queryable twin of the ``audit_divergence`` dump — the autopilot's
+        safety interlock polls this instead of parsing the recorder."""
+        return self._divergent
+
+    def status(self) -> Dict[str, Any]:
+        """Machine-readable auditor state: divergence flag + since-time,
+        plus the last sweep's summary counts."""
+        report = self.last_report or {}
+        return {"divergent": self._divergent,
+                "divergent_since": self._divergent_since,
+                "divergences": len(report.get("divergences", [])),
+                "unreachable": list(report.get("unreachable", [])),
+                "skews": int(report.get("skews", 0)),
+                "checked": self.last_report is not None}
 
     def _ledger_check(self, payloads: Dict[str, Dict[str, Any]]
                       ) -> List[Dict[str, Any]]:

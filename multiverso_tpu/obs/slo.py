@@ -166,6 +166,7 @@ class SLOEngine:
                           else default_objectives())
         self.objectives: List[Objective] = list(objectives)
         self._firing: Dict[str, bool] = {}
+        self._firing_since: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last: List[Evaluation] = []
@@ -223,6 +224,7 @@ class SLOEngine:
             was = self._firing.get(obj.name, False)
             self._firing[obj.name] = firing
             if firing and not was:
+                self._firing_since[obj.name] = time.time()
                 count("SLO_BURN_ALERTS")
                 log.error("slo: %s BURNING — short=%.6g long=%.6g "
                           "target=%.6g (burn %.2fx/%.2fx)", obj.name,
@@ -248,6 +250,7 @@ class SLOEngine:
                         pass
                 flight_dump("slo_burn", **details)
             elif was and not firing:
+                self._firing_since.pop(obj.name, None)
                 log.info("slo: %s recovered (short=%.6g target=%.6g)",
                          obj.name, v_short, obj.target)
             evals.append(ev)
@@ -256,6 +259,25 @@ class SLOEngine:
 
     def firing(self) -> List[str]:
         return [name for name, on in self._firing.items() if on]
+
+    def is_firing(self, name: str) -> bool:
+        """Is objective ``name`` currently burning? (queryable state the
+        autopilot's sensors read instead of parsing dumps)"""
+        return bool(self._firing.get(name, False))
+
+    def status(self) -> Dict[str, Any]:
+        """The engine's queryable state: per-objective last evaluation
+        plus firing/since — the machine-readable twin of render()."""
+        objectives = []
+        for ev in self.last:
+            o = ev.objective
+            objectives.append({
+                "name": o.name, "kind": o.kind, "metric": o.metric,
+                "target": o.target, "value_short": ev.value_short,
+                "value_long": ev.value_long, "burn_short": ev.burn_short,
+                "burn_long": ev.burn_long, "firing": ev.firing,
+                "firing_since": self._firing_since.get(o.name)})
+        return {"firing": self.firing(), "objectives": objectives}
 
     def render(self) -> str:
         """One line per objective — the ``mv.top`` SLO panel."""

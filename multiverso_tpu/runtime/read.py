@@ -49,7 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from multiverso_tpu import config, log
-from multiverso_tpu.dashboard import count, gauge_set
+from multiverso_tpu.dashboard import count, gauge_add, gauge_set
 from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime import wire
@@ -486,17 +486,25 @@ class ReadRouter:
 
     def hedge_delay(self) -> float:
         """p95 of recent replica read latencies (pooled), clamped to
-        [1 ms, read_timeout]; the read_hedge_ms flag pins it."""
+        [1 ms, read_timeout]; the read_hedge_ms flag pins it. The
+        derived value is exported as the READ_HEDGE_DELAY_SECONDS gauge
+        — the effective hedging posture operators (and the autopilot's
+        pressure sensors) read."""
         if self._hedge_ms > 0:
-            return min(self._hedge_ms / 1000.0, self.timeout)
-        samples: List[float] = []
-        for reader in self._readers:
-            samples.extend(reader.latencies)
-        if not samples:
-            return min(0.01, self.timeout)
-        samples.sort()
-        p95 = samples[min(len(samples) - 1, int(0.95 * len(samples)))]
-        return max(0.001, min(p95, self.timeout))
+            delay = min(self._hedge_ms / 1000.0, self.timeout)
+        else:
+            samples: List[float] = []
+            for reader in self._readers:
+                samples.extend(reader.latencies)
+            if not samples:
+                delay = min(0.01, self.timeout)
+            else:
+                samples.sort()
+                p95 = samples[min(len(samples) - 1,
+                                  int(0.95 * len(samples)))]
+                delay = max(0.001, min(p95, self.timeout))
+        gauge_set("READ_HEDGE_DELAY_SECONDS", delay)
+        return delay
 
     # -- entry point ---------------------------------------------------------
     def submit_get(self, table_id: int, request: Any, completion) -> int:
@@ -540,6 +548,10 @@ class _ReadAttempt:
         self._req_id = int(req_id)
         self._lock = threading.Lock()
         self._settled = False
+        # queue depth of the read tier: attempts alive between submit
+        # and settle. The exactly-once settle path is the exactly-once
+        # decrement, so the gauge can never drift negative.
+        gauge_add("READ_INFLIGHT", 1)
         self._tried: List[ReplicaReader] = []
         # live (reader, token) pairs — cancelled when someone wins
         self._inflight: List[Tuple[ReplicaReader, int]] = []
@@ -601,6 +613,7 @@ class _ReadAttempt:
             self._settled = True
             losers = [p for p in self._inflight if p != winner]
             self._inflight.clear()
+        gauge_add("READ_INFLIGHT", -1)
         for reader, token in losers:
             reader.cancel(token)
         if error is not None:

@@ -155,9 +155,11 @@ class ShardGroup:
 
     def _spawn(self, shard: int, standby: bool = False,
                primary: str = "", replica_index: Optional[int] = None,
-               takeover: bool = False) -> subprocess.Popen:
+               takeover: bool = False,
+               spec_path: Optional[str] = None) -> subprocess.Popen:
         argv = [sys.executable, "-m", "multiverso_tpu.shard._child",
-                "--spec", self.spec_path, "--shard", str(shard)]
+                "--spec", spec_path or self.spec_path,
+                "--shard", str(shard)]
         if standby:
             argv += ["--standby", "--primary", primary]
         elif replica_index is not None:
@@ -229,6 +231,110 @@ class ShardGroup:
             log.fatal("ShardGroup.connect before start()")
         return ShardedClient(self.layout, timeout=timeout,
                              read_preference=read_preference)
+
+    # -- live replica membership (the autopilot's actuator surface) ----------
+    def add_replica(self, shard: int, timeout: float = 120.0) -> str:
+        """Live-add one serving read replica to shard ``shard``: spawn a
+        fresh replica child against the shard's primary, wait for its
+        endpoint, and republish the manifest with it. ``layout_version``
+        is NOT bumped — replica membership moves no key ownership, so
+        in-flight sharded requests stay valid; routers pick up the new
+        read endpoint on their next layout refresh. Returns the new
+        replica's endpoint."""
+        if self.layout is None:
+            log.fatal("ShardGroup.add_replica before start()")
+        shard = int(shard)
+        if not 0 <= shard < self.num_shards:
+            raise ValueError(f"add_replica: shard {shard} out of range "
+                             f"(group has {self.num_shards})")
+        while len(self._replicas) < self.num_shards:
+            self._replicas.append([])
+        seqs = getattr(self, "_replica_seq", None)
+        if seqs is None:
+            seqs = self._replica_seq = {}
+        # spawn indices are monotonic per shard so a re-added replica can
+        # never adopt a removed one's stale endpoint file
+        i = seqs.get(shard, max(self.num_replicas,
+                                len(self._replicas[shard])))
+        seqs[shard] = i + 1
+        stale = os.path.join(self.base_dir, f"replica{shard}.{i}.endpoint")
+        if os.path.exists(stale):
+            os.remove(stale)
+        # spawn against a CURRENT-layout spec: after a live migration the
+        # start-time group.json holds pre-migration spans, and a replica
+        # built at stale bounds would silently diverge from its primary
+        manifest = self.layout.manifest
+        lv = int(manifest.get("layout_version", 1))
+        spec_path = os.path.join(self.base_dir, f"group-v{lv}.json")
+        spec = {"version": LAYOUT_VERSION,
+                "num_shards": self.num_shards,
+                "tables": manifest["tables"],
+                "flags": self.flags,
+                "host": self.host,
+                "wal_root": self.base_dir if self.durable else "",
+                "layout_path": self.layout_path}
+        tmp = spec_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(spec, f)
+        os.replace(tmp, spec_path)
+        proc = self._spawn(shard, replica_index=i,
+                           primary=self.endpoints[shard],
+                           spec_path=spec_path)
+        endpoint = self._await_file(f"replica{shard}.{i}.endpoint", shard,
+                                    time.monotonic() + timeout, proc=proc)
+        self._replicas[shard].append(proc)
+        manifest = dict(self.layout.manifest)
+        replicas = [list(r) for r in manifest.get("replicas", [])]
+        while len(replicas) < self.num_shards:
+            replicas.append([])
+        replicas[shard] = replicas[shard] + [endpoint]
+        manifest["replicas"] = replicas
+        self.publish_manifest(manifest)
+        log.info("shard %d: read replica added at %s (%d now serving)",
+                 shard, endpoint, len(replicas[shard]))
+        return endpoint
+
+    def remove_replica(self, shard: int,
+                       index: Optional[int] = None) -> str:
+        """Live-remove one of shard ``shard``'s read replicas (default:
+        the newest). The manifest republishes FIRST — routers refreshing
+        the layout stop picking the endpoint before the process dies,
+        and reads already in flight fail over through the read tier's
+        normal replica/primary fallback. Returns the removed
+        endpoint."""
+        if self.layout is None:
+            log.fatal("ShardGroup.remove_replica before start()")
+        shard = int(shard)
+        fleet = self._replicas[shard] if shard < len(self._replicas) else []
+        eps = (self.replica_endpoints[shard]
+               if shard < len(self.replica_endpoints) else [])
+        if not fleet or not eps or len(fleet) != len(eps):
+            raise ValueError(f"remove_replica: shard {shard} has no "
+                             f"removable replica (procs={len(fleet)}, "
+                             f"endpoints={len(eps)})")
+        if index is None:
+            index = len(fleet) - 1
+        index = int(index)
+        if not 0 <= index < len(fleet):
+            raise ValueError(f"remove_replica: shard {shard} replica "
+                             f"index {index} out of range")
+        endpoint = eps[index]
+        manifest = dict(self.layout.manifest)
+        replicas = [list(r) for r in manifest.get("replicas", [])]
+        replicas[shard] = [e for e in replicas[shard] if e != endpoint]
+        manifest["replicas"] = replicas
+        self.publish_manifest(manifest)
+        proc = fleet.pop(index)
+        if proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        log.info("shard %d: read replica %s removed (%d still serving)",
+                 shard, endpoint, len(replicas[shard]))
+        return endpoint
 
     # -- chaos / failover hooks ----------------------------------------------
     def kill_shard(self, shard: int) -> None:

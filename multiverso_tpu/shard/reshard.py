@@ -63,7 +63,7 @@ from typing import Any, Dict, List, Optional
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count
-from multiverso_tpu.obs.trace import hop
+from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import partitioner_from_spec
 
@@ -604,6 +604,7 @@ class HotRangeDetector:
                                else config.get_flag("reshard_hot_ratio"))
         self.min_qps = float(min_qps if min_qps is not None
                              else config.get_flag("reshard_min_qps"))
+        self.cold_qps = float(config.get_flag("reshard_cold_qps"))
 
     def shard_rates(self) -> List[float]:
         """Per-shard request rates (req/s) over the observation window."""
@@ -637,6 +638,32 @@ class HotRangeDetector:
                  else " (auto_reshard off: proposal only)")
         return proposal
 
+    def propose_merge(self) -> Optional[Dict[str, Any]]:
+        """-> {"op": "merge", "shard": k, "rate": .., "neighbor_rate": ..}
+        when two ADJACENT shards both idle below ``reshard_cold_qps``
+        (the merged shard at shard k absorbs k+1), else None."""
+        rates = self.shard_rates()
+        if len(rates) < 2:
+            return None  # nothing to merge into
+        best: Optional[int] = None
+        for k in range(len(rates) - 1):
+            if rates[k] >= self.cold_qps or rates[k + 1] >= self.cold_qps:
+                continue
+            if best is None or rates[k] + rates[k + 1] < \
+                    rates[best] + rates[best + 1]:
+                best = k
+        if best is None:
+            return None
+        count("RESHARD_PROPOSALS")
+        proposal = {"op": "merge", "shard": best,
+                    "rate": rates[best], "neighbor_rate": rates[best + 1]}
+        log.info("hot-range detector: shards %d+%d idle at %.1f/%.1f "
+                 "req/s (< %.1f) — proposing a merge%s", best, best + 1,
+                 rates[best], rates[best + 1], self.cold_qps,
+                 "" if config.get_flag("auto_reshard")
+                 else " (auto_reshard off: proposal only)")
+        return proposal
+
     def maybe_autosplit(self,
                         coordinator: MigrationCoordinator) -> Optional[Any]:
         """One detector tick: propose, and — only when ``auto_reshard``
@@ -645,3 +672,42 @@ class HotRangeDetector:
         if proposal is None or not config.get_flag("auto_reshard"):
             return None
         return coordinator.split(int(proposal["shard"]))
+
+    def tick(self, coordinator: Optional[MigrationCoordinator] = None
+             ) -> Optional[Dict[str, Any]]:
+        """One full detector tick: propose a split (or, failing that, a
+        cold-range merge) and — when ``auto_reshard`` is on and a
+        coordinator is given — execute it, RECORDING the outcome in the
+        timeseries (``RESHARD_EXECUTED`` / ``RESHARD_EXEC_FAILURES``)
+        and the flight recorder instead of only logging it. Returns the
+        proposal dict annotated with ``executed``/``error``, or None
+        when the group is balanced."""
+        proposal = self.propose()
+        if proposal is None:
+            proposal = self.propose_merge()
+        if proposal is None:
+            return None
+        proposal = dict(proposal)
+        proposal["executed"] = False
+        if coordinator is None or not config.get_flag("auto_reshard"):
+            return proposal
+        shard = int(proposal["shard"])
+        try:
+            if proposal["op"] == "split":
+                coordinator.split(shard)
+            else:
+                coordinator.merge(shard)
+            proposal["executed"] = True
+            count("RESHARD_EXECUTED")
+            flight_dump("reshard_executed", **proposal)
+        except MigrationError as exc:
+            # the coordinator already rolled forward to the old topology
+            # (MIGRATION_ROLLBACKS); record WHY the plan died so the
+            # operator reading the flight recorder sees cause, not just
+            # the rollback counter
+            proposal["error"] = str(exc)
+            count("RESHARD_EXEC_FAILURES")
+            flight_dump("reshard_exec_failed", **proposal)
+            log.error("reshard tick: %s of shard %d failed: %s",
+                      proposal["op"], shard, exc)
+        return proposal

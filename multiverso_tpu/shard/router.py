@@ -33,7 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from multiverso_tpu import config, log
-from multiverso_tpu.dashboard import count, observe
+from multiverso_tpu.dashboard import count, gauge_add, observe
 from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import (RangePartitioner,
@@ -525,9 +525,11 @@ class _MergeCompletion:
 
 class _PartCompletion:
     """One sub-request's completion: records the per-shard round trip in
-    ``ROUTER_SHARD<k>_SECONDS`` then reports to the merge parent."""
+    ``ROUTER_SHARD<k>_SECONDS`` (and the live queue depth in the
+    ``ROUTER_SHARD<k>_INFLIGHT`` gauge) then reports to the merge
+    parent."""
 
-    __slots__ = ("_parent", "_idx", "_shard", "_t0")
+    __slots__ = ("_parent", "_idx", "_shard", "_t0", "_settled")
 
     def __init__(self, parent: _MergeCompletion, idx: int,
                  shard: int) -> None:
@@ -535,15 +537,25 @@ class _PartCompletion:
         self._idx = idx
         self._shard = shard
         self._t0 = time.monotonic()
+        self._settled = False
+        gauge_add(f"ROUTER_SHARD{shard}_INFLIGHT", 1)
 
-    def done(self, result: Any) -> None:
+    def _observe(self) -> None:
+        # a retry hook may re-deliver; the gauge must decrement exactly
+        # once per sub-request or the depth drifts
+        if self._settled:
+            return
+        self._settled = True
         observe(f"ROUTER_SHARD{self._shard}_SECONDS",
                 time.monotonic() - self._t0)
+        gauge_add(f"ROUTER_SHARD{self._shard}_INFLIGHT", -1)
+
+    def done(self, result: Any) -> None:
+        self._observe()
         self._parent._part_done(self._idx, result)
 
     def fail(self, error: BaseException) -> None:
-        observe(f"ROUTER_SHARD{self._shard}_SECONDS",
-                time.monotonic() - self._t0)
+        self._observe()
         self._parent._part_fail(self._idx, self._shard, error)
 
 

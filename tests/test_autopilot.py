@@ -1,0 +1,797 @@
+"""Fleet autopilot (multiverso_tpu/autopilot/): the control loop that
+acts on its own telemetry.
+
+Unit layers run against fakes — policy hysteresis/cooldown/rejected
+alternatives, the latching safety interlock, detector tick outcome
+recording, actuator outcome truth, sensor snapshot assembly — and the
+live layers run real fleets:
+
+* live replica add/remove through the manifest (the actuator surface);
+* the Zipf-shift acceptance drill: a hot shard splits and a replica is
+  added by the autopilot itself, under a sustained write stream with
+  zero acknowledged-Add loss;
+* the seeded-divergence interlock drill (satellite): MV_AUDIT_CORRUPT
+  divergence freezes a RUNNING autopilot before its next action, and
+  only an explicit operator ack unfreezes it;
+* MV_AUTOPILOT_KILL chaos arms (self-skipping; the CI matrix sets the
+  env): the controller dying before or mid-action leaves the fleet
+  consistent, the loop frozen, and zero acked Adds lost.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu.autopilot import (Actuators, Autopilot, AutopilotKilled,
+                                      AutopilotPolicy, Decision, FleetSense,
+                                      FleetSensors, SafetyInterlock)
+from multiverso_tpu.dashboard import Dashboard, count, gauge_set, observe
+from multiverso_tpu.obs.timeseries import TimeSeriesRecorder
+from multiverso_tpu.runtime.remote import fetch_digest
+from multiverso_tpu.shard.group import ShardGroup
+from multiverso_tpu.shard.reshard import HotRangeDetector, MigrationError
+
+GROUP_FLAGS = {"remote_workers": 4, "heartbeat_seconds": 0.2,
+               "lease_seconds": 1.5, "request_retry_seconds": 1.0,
+               "reconnect_deadline_seconds": 30.0}
+
+
+@pytest.fixture(autouse=True)
+def _contain_chaos_env(request, monkeypatch):
+    """The CI chaos matrix exports MV_AUTOPILOT_KILL for the whole
+    pytest run; only the chaos drill may see it — every other test here
+    executes real actions and would be killed mid-flight."""
+    if "killed_mid_action" not in request.node.name:
+        monkeypatch.delenv("MV_AUTOPILOT_KILL", raising=False)
+
+
+# -- fakes --------------------------------------------------------------------
+
+class _Hist:
+    def __init__(self, count):
+        self.count = count
+
+
+class _Recorder:
+    """TimeSeriesRecorder stand-in driven by plain dicts."""
+
+    def __init__(self, shard_counts=None, rates=None, gauges=None,
+                 window=30.0):
+        self.shard_counts = dict(shard_counts or {})
+        self.rates = dict(rates or {})
+        self.gauges = dict(gauges or {})
+        self.window = window
+
+    def window_histogram(self, name, window):
+        if name.startswith("ROUTER_SHARD"):
+            k = int(name[len("ROUTER_SHARD"):].split("_")[0])
+            n = self.shard_counts.get(k, 0)
+            return _Hist(n) if n else None
+        return None
+
+    def rate(self, name, window):
+        return float(self.rates.get(name, 0.0))
+
+    def gauge(self, name):
+        return float(self.gauges.get(name, 0.0))
+
+    def quantile(self, name, q, window):
+        return 0.0
+
+
+class _Group:
+    """ShardGroup stand-in: membership calls recorded, never spawned."""
+
+    def __init__(self, num_shards=2):
+        self.num_shards = num_shards
+        self.replica_endpoints = [[] for _ in range(num_shards)]
+        self.calls = []
+
+    def add_replica(self, shard, timeout=120.0):
+        self.calls.append(("add", shard))
+        return f"h:{shard}"
+
+    def remove_replica(self, shard, index=None):
+        self.calls.append(("remove", shard))
+        return f"h:{shard}"
+
+
+class _Detector:
+    """Detector stand-in returning canned proposals (no counters)."""
+
+    def __init__(self, split=None, merge=None):
+        self.split_p, self.merge_p = split, merge
+        self.cold_qps = 5.0
+        self.num_shards = 2
+
+    def propose(self):
+        return dict(self.split_p) if self.split_p else None
+
+    def propose_merge(self):
+        return dict(self.merge_p) if self.merge_p else None
+
+
+class _ForcedPolicy:
+    """Policy stand-in that always decides one canned action."""
+
+    def __init__(self, decision):
+        self.decision = decision
+        self.recorded = []
+
+    def decide(self, sense):
+        return self.decision
+
+    def record_action(self, action, now=None):
+        self.recorded.append(action)
+
+    def state_snapshot(self, now=None):
+        return {"streaks": {}, "cooldowns": {}}
+
+
+def _sense(**kw):
+    base = dict(now=1000.0, shard_rates=[0.0, 0.0], total_qps=0.0,
+                read_pressure=0.0, replica_lag={}, replica_counts=[0, 0],
+                get_p99=0.0, tier_hit_rate=None, tier_resident_bytes=0.0,
+                slo_firing=[], audit_divergent=False)
+    base.update(kw)
+    return FleetSense(**base)
+
+
+_SPLIT = {"op": "split", "shard": 1, "rate": 90.0, "median": 3.0}
+_MERGE = {"op": "merge", "shard": 0, "rate": 0.2, "neighbor_rate": 0.1}
+
+
+# -- policy: hysteresis, cooldown, rejected alternatives ----------------------
+
+def test_policy_split_waits_for_hysteresis_then_fires():
+    mv.set_flag("autopilot_hysteresis_ticks", 2)
+    pol = AutopilotPolicy(_Detector(split=_SPLIT))
+    d1 = pol.decide(_sense())
+    assert d1.action == "none"
+    assert any(a["action"] == "split" and "hysteresis 1/2" in a["reason"]
+               for a in d1.alternatives)
+    d2 = pol.decide(_sense())
+    assert d2.action == "split" and d2.shard == 1 and d2.risky
+    assert d2.params["rate"] == 90.0
+
+
+def test_policy_streak_resets_when_condition_breaks():
+    mv.set_flag("autopilot_hysteresis_ticks", 2)
+    det = _Detector(split=_SPLIT)
+    pol = AutopilotPolicy(det)
+    assert pol.decide(_sense()).action == "none"   # streak 1/2
+    det.split_p = None                             # one calm tick
+    assert pol.decide(_sense()).action == "none"   # streak reset
+    det.split_p = _SPLIT
+    assert pol.decide(_sense()).action == "none"   # back to 1/2
+    assert pol.decide(_sense()).action == "split"
+
+
+def test_policy_cooldown_bars_repeat_and_snapshot_shows_it():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    pol = AutopilotPolicy(_Detector(split=_SPLIT))
+    now = 1000.0
+    assert pol.decide(_sense(now=now)).action == "split"
+    pol.record_action("split", now=now)
+    d = pol.decide(_sense(now=now + 1.0))
+    assert d.action == "none"
+    assert any(a["action"] == "split" and "cooldown" in a["reason"]
+               for a in d.alternatives)
+    snap = pol.state_snapshot(now=now + 1.0)
+    assert snap["cooldowns"]["split"] > 0
+    # past the cooldown the rule fires again
+    assert pol.decide(_sense(now=now + pol.cooldown + 1)).action == "split"
+
+
+def test_policy_merge_fires_and_split_outranks_it():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    pol = AutopilotPolicy(_Detector(merge=_MERGE))
+    d = pol.decide(_sense())
+    assert d.action == "merge" and d.shard == 0 and d.risky
+    both = AutopilotPolicy(_Detector(split=_SPLIT, merge=_MERGE))
+    assert both.decide(_sense()).action == "split"
+
+
+def test_policy_add_replica_on_read_pressure_picks_thinnest():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    pol = AutopilotPolicy(_Detector())
+    d = pol.decide(_sense(read_pressure=20.0, replica_counts=[2, 0],
+                          replica_lag={0: 7}, total_qps=50.0))
+    assert d.action == "add_replica" and d.shard == 1
+    # replica lag rides along as a rejected alternative, never a trigger
+    assert any(a["action"] == "add_replica" and "WAL" in a["reason"]
+               for a in d.alternatives)
+
+
+def test_policy_add_replica_respects_ceiling():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    mv.set_flag("autopilot_max_replicas", 1)
+    pol = AutopilotPolicy(_Detector())
+    d = pol.decide(_sense(read_pressure=20.0, replica_counts=[1, 1],
+                          total_qps=50.0))
+    assert d.action == "none"
+    assert any(a["action"] == "add_replica" and "ceiling" in a["reason"]
+               for a in d.alternatives)
+
+
+def test_policy_remove_replica_when_idle_above_floor():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    pol = AutopilotPolicy(_Detector())
+    d = pol.decide(_sense(total_qps=0.1, replica_counts=[2, 1]))
+    assert d.action == "remove_replica" and d.shard == 0  # the fattest
+    # at the floor nothing is removable
+    mv.set_flag("autopilot_min_replicas", 1)
+    pol2 = AutopilotPolicy(_Detector())
+    assert pol2.decide(_sense(total_qps=0.1,
+                              replica_counts=[1, 1])).action == "none"
+
+
+def test_policy_tier_rebalance_up_down_and_ceiling():
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    mv.set_flag("tier_resident_bytes", 32 << 20)
+    pol = AutopilotPolicy(_Detector())
+    d = pol.decide(_sense(tier_hit_rate=0.5, total_qps=50.0))
+    assert d.action == "tier_up"
+    assert d.params == {"from": 32 << 20, "to": (32 << 20) + pol.tier_step}
+    # shrink when the hit rate holds and residency uses under half
+    d2 = pol.decide(_sense(tier_hit_rate=0.95, total_qps=50.0,
+                           tier_resident_bytes=float(1 << 20)))
+    assert d2.action == "tier_down"
+    assert d2.params["to"] == (32 << 20) - pol.tier_step
+    # at the byte ceiling the miss pressure lands as an alternative
+    mv.set_flag("autopilot_tier_max_bytes", 32 << 20)
+    pol3 = AutopilotPolicy(_Detector())
+    d3 = pol3.decide(_sense(tier_hit_rate=0.5, total_qps=50.0))
+    assert d3.action == "none"
+    assert any(a["action"] == "tier_up" and "ceiling" in a["reason"]
+               for a in d3.alternatives)
+
+
+# -- safety interlock ---------------------------------------------------------
+
+def test_interlock_latches_on_divergence_until_operator_ack():
+    class _Aud:
+        divergent = True
+
+        def status(self):
+            return {"divergent": True}
+
+    aud = _Aud()
+    lock = SafetyInterlock(aud)
+    assert not lock.check()
+    assert lock.frozen
+    assert Dashboard.counter_value("AUTOPILOT_FREEZES") == 1
+    assert Dashboard.gauge_value("AUTOPILOT_FROZEN") == 1
+    aud.divergent = False          # fleet "recovered" unsupervised
+    assert not lock.check()        # the latch holds regardless
+    assert Dashboard.counter_value("AUTOPILOT_FREEZES") == 1  # idempotent
+    lock.ack("oncall")
+    assert Dashboard.counter_value("AUTOPILOT_ACKS") == 1
+    assert Dashboard.gauge_value("AUTOPILOT_FROZEN") == 0
+    assert lock.check() and not lock.frozen
+
+
+def test_interlock_counter_trigger_and_ack_rebaseline():
+    count("AUDIT_DIVERGENCE")      # history predating the autopilot
+    lock = SafetyInterlock()
+    assert lock.check()            # old divergences never refuse a start
+    count("AUDIT_DIVERGENCE")
+    assert not lock.check() and lock.frozen
+    assert "AUDIT_DIVERGENCE" in lock.freeze_reason
+    lock.ack()
+    assert lock.check()            # re-baselined
+    count("AUDIT_DIVERGENCE")
+    assert not lock.check()        # fresh divergence freezes again
+
+
+# -- detector tick: execution outcomes recorded -------------------------------
+
+class _Coord:
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def split(self, shard):
+        self.calls.append(("split", shard))
+        if self.fail:
+            raise MigrationError("cutover failed (drill)")
+
+    def merge(self, shard):
+        self.calls.append(("merge", shard))
+        if self.fail:
+            raise MigrationError("cutover failed (drill)")
+
+
+def test_detector_tick_executes_behind_flag_and_counts_success():
+    det = HotRangeDetector(2, recorder=_Recorder({0: 9000, 1: 30}),
+                           hot_ratio=3.0, min_qps=1.0)
+    coord = _Coord()
+    out = det.tick(coord)          # auto_reshard off: proposal only
+    assert out["op"] == "split" and out["executed"] is False
+    assert not coord.calls
+    mv.set_flag("auto_reshard", True)
+    out = det.tick(coord)
+    assert out["executed"] is True and coord.calls == [("split", 0)]
+    assert Dashboard.counter_value("RESHARD_EXECUTED") == 1
+
+
+def test_detector_tick_records_migration_failure():
+    mv.set_flag("auto_reshard", True)
+    det = HotRangeDetector(2, recorder=_Recorder({0: 9000, 1: 30}),
+                           hot_ratio=3.0, min_qps=1.0)
+    out = det.tick(_Coord(fail=True))
+    assert out["executed"] is False and "cutover" in out["error"]
+    assert Dashboard.counter_value("RESHARD_EXEC_FAILURES") == 1
+    assert Dashboard.counter_value("RESHARD_EXECUTED") == 0
+
+
+def test_detector_proposes_cold_adjacent_merge():
+    mv.set_flag("reshard_cold_qps", 2.0)
+    det = HotRangeDetector(3, recorder=_Recorder({0: 30, 1: 6, 2: 3}),
+                           hot_ratio=3.0, min_qps=50.0)
+    out = det.tick()               # no split (under the qps floor)
+    assert out == {"op": "merge", "shard": 1, "rate": 0.2,
+                   "neighbor_rate": 0.1, "executed": False}
+    assert Dashboard.counter_value("RESHARD_PROPOSALS") == 1
+    # a warm neighbor blocks the merge
+    mv.set_flag("reshard_cold_qps", 0.15)
+    warm = HotRangeDetector(3, recorder=_Recorder({0: 30, 1: 6, 2: 3}),
+                            hot_ratio=3.0, min_qps=50.0)
+    assert warm.propose_merge() is None
+
+
+# -- sensors ------------------------------------------------------------------
+
+def test_sensors_snapshot_reads_recorder_and_probes_lag():
+    group = _Group(num_shards=2)
+    group.replica_endpoints = [["h:1", "h:2"], []]
+    probed = []
+
+    def probe(ep, timeout=2.0):
+        probed.append(ep)
+        if ep == "h:2":
+            raise OSError("unreachable (the auditor's business)")
+        return {"lag": 5}
+
+    rec = _Recorder(shard_counts={0: 60, 1: 30},
+                    rates={"READ_HEDGES": 2.0,
+                           "READ_PRIMARY_FALLBACKS": 1.5,
+                           "TIER_HOT_HITS": 9.0, "TIER_COLD_HITS": 1.0},
+                    gauges={"TIER_RESIDENT_BYTES": 4096.0})
+    sens = FleetSensors(group, recorder=rec, window=30.0, probe=probe)
+    s = sens.read(now=10.0)
+    assert s.shard_rates == [2.0, 1.0] and s.total_qps == 3.0
+    assert s.read_pressure == 3.5
+    assert s.replica_lag == {0: 5}          # worst lag; h:2 skipped
+    assert s.replica_counts == [2, 0]
+    assert s.tier_hit_rate == 0.9
+    assert s.tier_resident_bytes == 4096.0
+    assert sorted(probed) == ["h:1", "h:2"]
+    # the worst per-shard lag republishes as a local gauge operators
+    # (and Prometheus) scrape from the controlling process
+    assert Dashboard.gauge_value("FLEET_SHARD0_REPLICA_LAG") == 5
+
+
+def test_prom_exposition_splits_shard_series_into_labels():
+    gauge_set("FLEET_SHARD3_REPLICA_LAG", 7)
+    observe("ROUTER_SHARD1_SECONDS", 0.01)
+    count("RESHARD_EXECUTED")
+    text = Dashboard.render(format="prom")
+    assert 'mvtpu_fleet_replica_lag{shard="3"} 7' in text
+    assert 'mvtpu_router_seconds_bucket{shard="1",le="+Inf"} 1' in text
+    assert "mvtpu_reshard_executed_total 1" in text
+    # one # TYPE line per family even with per-shard series
+    assert text.count("# TYPE mvtpu_router_seconds histogram") == 1
+
+
+# -- actuators ----------------------------------------------------------------
+
+def test_actuators_dispatch_membership_and_count_outcomes():
+    group = _Group()
+    act = Actuators(group)
+    out = act.execute(Decision(action="add_replica", shard=1))
+    assert out["ok"] and out["detail"]["endpoint"] == "h:1"
+    out = act.execute(Decision(action="remove_replica", shard=0))
+    assert out["ok"] and group.calls == [("add", 1), ("remove", 0)]
+    assert Dashboard.counter_value("AUTOPILOT_ACTIONS") == 2
+
+
+def test_actuators_failure_is_an_outcome_not_a_crash():
+    class _Bad(_Group):
+        def add_replica(self, shard, timeout=120.0):
+            raise RuntimeError("spawn failed (drill)")
+
+    out = Actuators(_Bad()).execute(Decision(action="add_replica", shard=0))
+    assert out["ok"] is False and "spawn failed" in out["error"]
+    assert Dashboard.counter_value("AUTOPILOT_ACTION_FAILURES") == 1
+    assert Dashboard.counter_value("AUTOPILOT_ACTIONS") == 0
+
+
+def test_actuators_retier_updates_flag_and_registered_store():
+    class _Store:
+        row_bytes = 64
+        budget = 0
+        _promote_slack = 0
+        maintained = 0
+
+        def maintain(self):
+            self.maintained += 1
+
+    act = Actuators(_Group())
+    store = _Store()
+    act.register_tiered_store(store)
+    out = act.execute(Decision(action="tier_up",
+                               params={"from": 1 << 20, "to": 123456}))
+    assert out["ok"] and out["detail"] == {"budget": 123456,
+                                           "stores_resized": 1}
+    assert int(mv.get_flag("tier_resident_bytes")) == 123456
+    assert store.budget == 123456 and store.maintained == 1
+
+
+# -- the control loop over fakes ----------------------------------------------
+
+def _fake_pilot(decision=None, auditor=None, group=None, actuators=None):
+    group = group if group is not None else _Group()
+    rec = _Recorder()
+    return Autopilot(
+        group, interval=0, detector=_Detector(),
+        sensors=FleetSensors(group, recorder=rec, auditor=auditor,
+                             probe=lambda ep, timeout=2.0: {"lag": 0}),
+        policy=_ForcedPolicy(decision) if decision is not None else None,
+        actuators=actuators if actuators is not None else Actuators(group),
+        interlock=SafetyInterlock(auditor))
+
+
+def test_autopilot_tick_records_history_and_frozen_skips():
+    pilot = _fake_pilot()
+    rec = pilot.tick_now(now=1.0)
+    assert rec["action"] == "none" and pilot.ticks == 1
+    assert rec["decision"]["reason"] == "fleet within all envelopes"
+    assert Dashboard.counter_value("AUTOPILOT_TICKS") == 1
+    pilot.interlock.freeze("drill")
+    rec = pilot.tick_now(now=2.0)
+    assert rec["action"] == "frozen"
+    assert Dashboard.counter_value("AUTOPILOT_FROZEN_SKIPS") == 1
+    assert len(pilot.history) == 2
+    assert pilot.status()["interlock"]["frozen"]
+
+
+def test_autopilot_executes_decision_and_dumps_flight_record(tmp_path):
+    flight = str(tmp_path / "flight.jsonl")
+    mv.set_flag("flight_recorder_path", flight)
+    group = _Group()
+    pilot = _fake_pilot(decision=Decision(action="add_replica", shard=0,
+                                          reason="drill"), group=group)
+    rec = pilot.tick_now(now=1.0)
+    assert rec["outcome"]["ok"] and group.calls == [("add", 0)]
+    assert pilot.policy.recorded == ["add_replica"]  # cooldown stamped
+    with open(flight, encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()]
+    dumps = [e for e in events if e.get("reason") == "autopilot_decision"]
+    assert dumps and dumps[0]["decision"]["action"] == "add_replica"
+    assert dumps[0]["outcome"]["ok"] is True
+    assert "sense" in dumps[0] and "policy" in dumps[0]
+
+
+def test_autopilot_failed_action_still_cools_down():
+    class _Bad(_Group):
+        def add_replica(self, shard, timeout=120.0):
+            raise RuntimeError("spawn failed (drill)")
+
+    group = _Bad()
+    pilot = _fake_pilot(decision=Decision(action="add_replica", shard=0),
+                        group=group)
+    rec = pilot.tick_now(now=1.0)
+    assert rec["outcome"]["ok"] is False
+    # a failed migration must not be retried every tick
+    assert pilot.policy.recorded == ["add_replica"]
+
+
+def test_autopilot_kill_hook_freezes_loop(monkeypatch):
+    monkeypatch.setenv("MV_AUTOPILOT_KILL", "before")
+    group = _Group()
+    pilot = _fake_pilot(decision=Decision(action="add_replica", shard=0),
+                        group=group)
+    rec = pilot.tick_now(now=1.0)
+    assert rec["outcome"]["killed"] and rec["outcome"]["ok"] is False
+    assert pilot.interlock.frozen and pilot._stop.is_set()
+    assert group.calls == []       # killed BEFORE the dispatch
+    # the latch outlives the chaos env: still frozen, still skipping
+    monkeypatch.delenv("MV_AUTOPILOT_KILL")
+    assert pilot.tick_now(now=2.0)["action"] == "frozen"
+
+
+def test_autopilot_kill_spec_filters_by_action(monkeypatch):
+    monkeypatch.setenv("MV_AUTOPILOT_KILL", "before:split")
+    group = _Group()
+    pilot = _fake_pilot(decision=Decision(action="add_replica", shard=0),
+                        group=group)
+    rec = pilot.tick_now(now=1.0)  # spec names split: add_replica runs
+    assert rec["outcome"]["ok"] and group.calls == [("add", 0)]
+    assert not pilot.interlock.frozen
+
+
+# -- live: replica membership through the manifest ----------------------------
+
+def test_live_add_and_remove_replica_republishes_manifest():
+    tables = [{"kind": "matrix", "num_row": 16, "num_col": 2}]
+    with ShardGroup(tables, shards=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        (mat,) = client.tables()
+        mat.add(np.ones((16, 2), np.float32))
+
+        ep = group.add_replica(0)
+        assert group.replica_endpoints[0] == [ep]
+        assert group.layout.manifest["replicas"][0] == [ep]
+        # replica membership never bumps the layout version (no key
+        # ownership moved; in-flight stamped requests stay valid)
+        assert group.layout.manifest["layout_version"] == 1
+
+        # the new replica catches up to the primary's watermark
+        primary_wm = fetch_digest(group.endpoints[0],
+                                  timeout=30.0)["watermark"]
+        deadline = time.monotonic() + 60.0
+        caught_up = False
+        while time.monotonic() < deadline:
+            if fetch_digest(ep, timeout=30.0)["watermark"] >= primary_wm:
+                caught_up = True
+                break
+            time.sleep(0.1)
+        assert caught_up, "live-added replica never caught up"
+
+        removed = group.remove_replica(0)
+        assert removed == ep
+        assert group.replica_endpoints[0] == []
+        assert group.layout.manifest["replicas"][0] == []
+        # the primary still serves
+        np.testing.assert_array_equal(mat.get(),
+                                      np.ones((16, 2), np.float32))
+        client.close()
+
+
+# -- live: the Zipf-shift acceptance drill ------------------------------------
+
+def test_autopilot_zipf_shift_splits_hot_shard_then_adds_replica():
+    """The acceptance drill: traffic concentrates on shard 0 (a Zipf
+    hotspot shift), the autopilot reads its own telemetry and SPLITS the
+    hot shard through the live migration machinery while writers stream;
+    read-tier pressure then drives an add_replica — all with zero
+    acknowledged-Add loss (bit-identical mirror equality)."""
+    mv.set_flag("autopilot_hysteresis_ticks", 1)
+    mv.set_flag("autopilot_window_seconds", 4.0)
+    mv.set_flag("autopilot_hedge_rate", 1.0)
+    mv.set_flag("reshard_cold_qps", 0.0)   # no merges in this drill
+    mv.set_flag("reshard_min_qps", 1.0)
+    mv.set_flag("reshard_hot_ratio", 2.0)
+
+    tables = [{"kind": "matrix", "num_row": 32, "num_col": 4}]
+    recorder = TimeSeriesRecorder(interval=3600.0, samples=16)
+    with ShardGroup(tables, shards=2, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        (mat,) = client.tables()
+        model = np.zeros((32, 4), np.float32)
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def writer(seed):
+            # the hotspot: every write lands in rows [0, 16) == shard 0
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                ids = rng.choice(16, 6, replace=False).astype(np.int32)
+                vals = rng.integers(0, 5, (6, 4)).astype(np.float32)
+                mat.add(vals, row_ids=ids)
+                with lock:
+                    model[ids] += vals
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=writer, args=(s,), daemon=True)
+                   for s in (1, 2)]
+        pilot = mv.autopilot(group, interval=0, recorder=recorder)
+        recorder.sample_now(t=100.0)
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        recorder.sample_now(t=104.0)   # the window now shows the hotspot
+
+        rec1 = pilot.tick_now(now=104.0)
+        assert rec1["action"] == "split", rec1
+        assert rec1["decision"]["shard"] == 0 and rec1["outcome"]["ok"]
+        assert group.num_shards == 3
+
+        time.sleep(0.5)                # keep writing on the new layout
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # the read tier comes under pressure (hedge telemetry is the
+        # replica-scaling signal; the counter bump stands in for the
+        # hedged-read machinery the read tests exercise)
+        recorder.sample_now(t=108.0)
+        count("READ_HEDGES", 40)
+        recorder.sample_now(t=112.0)
+        rec2 = pilot.tick_now(now=112.0)
+        assert rec2["action"] == "add_replica", rec2
+        assert rec2["outcome"]["ok"], rec2
+        added = rec2["outcome"]["detail"]["endpoint"]
+        shard = rec2["decision"]["shard"]
+        assert group.replica_endpoints[shard] == [added]
+        assert Dashboard.counter_value("AUTOPILOT_ACTIONS") == 2
+
+        # zero acknowledged-Add loss across the autopilot's actions
+        np.testing.assert_array_equal(mat.get(), model)
+        assert client.layout.layout_version == 2
+        client.close()
+
+        # a fresh client bootstraps onto the autopilot-reshaped fleet
+        c2 = group.connect()
+        assert c2.layout.num_shards == 3
+        np.testing.assert_array_equal(c2.tables()[0].get(), model)
+        c2.close()
+        pilot.stop()
+
+
+# -- live: the seeded-divergence interlock drill (satellite) ------------------
+
+def test_audit_divergence_freezes_running_autopilot_until_ack(tmp_path,
+                                                              monkeypatch):
+    """Satellite: seeded MV_AUDIT_CORRUPT divergence must freeze a
+    RUNNING autopilot before its next action, and only an explicit
+    operator ack unfreezes it (persisting divergence refreezes on the
+    very next tick — an ack is consent to resume, not a mute)."""
+    flight = str(tmp_path / "flight.jsonl")
+    mv.set_flag("flight_recorder_path", flight)
+    monkeypatch.setenv("MV_AUDIT_CORRUPT", "0:7:2")  # table 0 row 7
+    with ShardGroup([{"kind": "sparse", "key_space": 100, "width": 2}],
+                    shards=1, replicas=1, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        monkeypatch.delenv("MV_AUDIT_CORRUPT")  # children already armed
+        client = group.connect()
+        (sp,) = client.tables()
+        sp.add(np.array([7], np.int64), np.ones((1, 2), np.float32))
+        sp.add(np.array([9], np.int64), np.ones((1, 2), np.float32))
+
+        # wait for the replica to catch up before auditing
+        primary_wm = fetch_digest(group.endpoints[0],
+                                  timeout=30.0)["watermark"]
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if fetch_digest(group.replica_endpoints[0][0],
+                            timeout=30.0)["watermark"] >= primary_wm:
+                break
+            time.sleep(0.1)
+
+        auditor = mv.audit(group, interval=0.2)
+        # a RUNNING autopilot with a queued action every tick; recording
+        # actuators prove no action ever crosses a frozen interlock
+        group_probe = _Group()
+        pilot = mv.autopilot(
+            group, interval=0, auditor=auditor,
+            actuators=Actuators(group_probe),
+            policy=_ForcedPolicy(Decision(action="add_replica", shard=0,
+                                          reason="drill pressure")))
+        assert pilot.tick_now()["outcome"]["ok"]  # pre-divergence: acts
+        assert group_probe.calls == [("add", 0)]
+
+        try:
+            deadline = time.monotonic() + 30.0
+            while (Dashboard.counter_value("AUDIT_DIVERGENCE") == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert Dashboard.counter_value("AUDIT_DIVERGENCE") > 0
+
+            rec = pilot.tick_now()           # the next action is due...
+            assert rec["action"] == "frozen"  # ...and never dispatches
+            assert group_probe.calls == [("add", 0)]
+            assert Dashboard.gauge_value("AUTOPILOT_FROZEN") == 1
+
+            # no amount of further ticking unfreezes it
+            assert pilot.tick_now()["action"] == "frozen"
+            assert Dashboard.counter_value("AUTOPILOT_FROZEN_SKIPS") >= 2
+
+            # the explicit operator ack is the ONLY unfreeze
+            pilot.ack(operator="drill-oncall")
+            assert not pilot.interlock.frozen
+            assert Dashboard.counter_value("AUTOPILOT_ACKS") == 1
+            # the corrupted replica still diverges: the next tick
+            # refreezes instead of acting on a sick fleet
+            assert pilot.tick_now()["action"] == "frozen"
+            assert group_probe.calls == [("add", 0)]
+        finally:
+            auditor.stop()
+        client.close()
+    with open(flight, encoding="utf-8") as fh:
+        events = [json.loads(l) for l in fh if l.strip()]
+    frozen = [e for e in events if e.get("kind") == "event"
+              and e.get("reason") == "autopilot_frozen"]
+    assert frozen and "AUDIT_DIVERGENCE" in frozen[0]["why"]
+
+    art_dir = os.environ.get("MV_CHAOS_ARTIFACT_DIR")
+    if art_dir:  # CI post-mortem artifact
+        os.makedirs(art_dir, exist_ok=True)
+        import shutil
+        shutil.copy(flight, os.path.join(
+            art_dir, "autopilot-freeze-flight.jsonl"))
+
+
+# -- live: MV_AUTOPILOT_KILL chaos arms (CI chaos matrix) ---------------------
+
+@pytest.mark.skipif(os.environ.get("MV_AUTOPILOT_KILL")
+                    not in ("before", "mid"),
+                    reason="chaos drill: set MV_AUTOPILOT_KILL="
+                           "before|mid (ci chaos matrix)")
+def test_autopilot_killed_mid_action_leaves_fleet_consistent():
+    """The controller dies before ('before') or right after ('mid') the
+    crash-safe operation: either way the fleet stays consistent with
+    zero acked-Add loss, and the loop latches frozen."""
+    stage = os.environ["MV_AUTOPILOT_KILL"]
+    tables = [{"kind": "matrix", "num_row": 32, "num_col": 4}]
+    with ShardGroup(tables, shards=2, durable=True,
+                    flags=dict(GROUP_FLAGS)) as group:
+        group.start(timeout=180)
+        client = group.connect()
+        (mat,) = client.tables()
+        model = np.zeros((32, 4), np.float32)
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def writer(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                ids = rng.choice(32, 6, replace=False).astype(np.int32)
+                vals = rng.integers(0, 5, (6, 4)).astype(np.float32)
+                mat.add(vals, row_ids=ids)
+                with lock:
+                    model[ids] += vals
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=writer, args=(s,), daemon=True)
+                   for s in (1, 2)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+
+        pilot = mv.autopilot(
+            group, interval=0,
+            policy=_ForcedPolicy(Decision(action="split", shard=0,
+                                          risky=True, reason="chaos")))
+        rec = pilot.tick_now()
+        assert rec["outcome"]["killed"] and pilot.interlock.frozen
+        # 'before' kills ahead of the migration (fleet untouched);
+        # 'mid' kills after it committed (fleet reshaped, controller
+        # dead before its bookkeeping)
+        expected_shards = {"before": 2, "mid": 3}[stage]
+        assert group.num_shards == expected_shards
+        # frozen: no further action ever dispatches
+        assert pilot.tick_now()["action"] == "frozen"
+
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        # zero acked-Add loss either way — the layer below was crash-safe
+        np.testing.assert_array_equal(mat.get(), model)
+        client.close()
+        c2 = group.connect()
+        assert c2.layout.num_shards == expected_shards
+        np.testing.assert_array_equal(c2.tables()[0].get(), model)
+        c2.close()
+
+    art_dir = os.environ.get("MV_CHAOS_ARTIFACT_DIR")
+    if art_dir:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir,
+                               f"autopilot-kill-{stage}.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump({"stage": stage, "final_shards": expected_shards,
+                       "frozen": True}, fh, indent=1)
