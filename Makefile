@@ -57,6 +57,14 @@
 #   make autopilot-bench  Zipf hotspot shift against a live group:
 #                       time-to-split, p99 recovery, acked-Add
 #                       conservation
+#   make overload       overload-survival suite: deadline propagation,
+#                       priority lanes + admission shedding + tenant
+#                       quotas, retry budget + circuit breaker, stall
+#                       gray-failure chaos, and the train-while-serve
+#                       drill (docs/fault_tolerance.md §9)
+#   make overload-bench overload leg only: shed rate, per-lane p99s,
+#                       retry-budget denials, acked-Add conservation
+#                       under a stalled shard (BENCH_r11.json)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -64,9 +72,11 @@ CHAOS_SEED ?= 7
 
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
-	audit audit-bench autopilot autopilot-bench clean
+	audit audit-bench autopilot autopilot-bench overload overload-bench \
+	clean
 
-check: lint native test dryrun profile-smoke tiered audit autopilot bench
+check: lint native test dryrun profile-smoke tiered audit autopilot \
+	overload bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -144,6 +154,13 @@ autopilot:
 
 autopilot-bench:
 	$(CPU_ENV) $(PYTHON) bench.py --autopilot-bench
+
+overload:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_overload.py -q \
+		-p no:cacheprovider -p no:randomly
+
+overload-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --overload-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -1591,6 +1591,153 @@ def bench_autopilot(rows=256, cols=16, zipf_s=1.2, tick_interval=0.5,
         group.stop()
 
 
+def bench_overload(rows=64, cols=8, seconds=6.0, zipf_s=1.2,
+                   queue_limit=4, tenant_qps=40.0, tenant_burst=20):
+    """Overload-survival leg (docs/fault_tolerance.md overload runbook):
+    the train-while-serve drill as a measured bench. A 2-shard matrix
+    group runs with the full governor stack armed — priority lanes,
+    admission queue limit, a tenant token bucket on the training table,
+    request deadlines, client retry budget and circuit breaker — while
+    shard 1's primary drips its Add replies through the ``stall``
+    gray-failure chaos mode. Four unthrottled Zipf writers storm both
+    shards and two readers flood hot keys on the healthy shard.
+
+    Reports the shed rate (refused Adds / attempted Adds — the gate's
+    brownout depth), per-lane client p99s (serving Gets vs training
+    Adds: the number the lanes exist to protect), retry-budget denials,
+    breaker trips, deadline drops, and the acked-Add conservation check
+    (applied + shed must equal every completion a writer saw —
+    ``overload_acked_adds_lost`` must be 0)."""
+    import os
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.shard.group import ShardGroup
+
+    span = rows // 2                     # shard 0 owns rows [0, span)
+    os.environ["MV_CHAOS_SHARD"] = "1"
+    os.environ["MV_CHAOS_SPEC"] = "stall:type=Reply_Add,every=2,seconds=0.25"
+    mv.set_flag("request_retry_seconds", 0.2)
+    mv.set_flag("retry_budget_tokens", 8.0)
+    mv.set_flag("retry_budget_ratio", 0.5)
+    mv.set_flag("breaker_failures", 3)
+    mv.set_flag("breaker_reset_seconds", 0.5)
+    group = ShardGroup(
+        [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+        shards=2,
+        flags={"remote_workers": 8,
+               "request_retry_seconds": 0.2,
+               "request_deadline_seconds": 30.0,
+               "admission_queue_limit": queue_limit,
+               "tenant_quota_spec":
+                   f"train:tables=0,qps={tenant_qps},burst={tenant_burst}",
+               "heartbeat_seconds": 0.2}).start()
+    try:
+        client = group.connect()
+        table = client.table(0)
+        stop = threading.Event()
+        completions = [0, 0]
+        lock = threading.Lock()
+        add_lat, read_lat, lat_lock = [], [], threading.Lock()
+        errors = []
+
+        def writer(shard, seed):
+            gen = TrafficGen(span, zipf_s=zipf_s, read_fraction=0.0,
+                             seed=seed)
+            vals = np.ones((1, cols), np.float32)
+            ids = np.zeros(1, np.int32)
+            while not stop.is_set():
+                ids[0] = shard * span + gen.draw_key()
+                t0 = time.perf_counter()
+                try:
+                    table.add(vals, row_ids=ids)
+                except Exception as exc:  # noqa: BLE001
+                    if "circuit open" in repr(exc):
+                        time.sleep(0.05)  # truthful fast-fail: back off
+                        continue
+                    errors.append(exc)
+                    return
+                with lat_lock:
+                    add_lat.append(time.perf_counter() - t0)
+                with lock:
+                    completions[shard] += 1
+
+        def reader():
+            gen = TrafficGen(span, zipf_s=zipf_s, read_fraction=1.0,
+                             seed=42)
+            ids = np.zeros(1, np.int32)
+            while not stop.is_set():
+                ids[0] = gen.draw_key()  # rows [0, span): healthy shard
+                t0 = time.perf_counter()
+                try:
+                    table.get(row_ids=ids)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+                with lat_lock:
+                    read_lat.append(time.perf_counter() - t0)
+
+        threads = ([threading.Thread(target=writer, args=(s, 10 + s),
+                                     daemon=True)
+                    for s in (0, 1) for _ in range(2)]
+                   + [threading.Thread(target=reader, daemon=True)
+                      for _ in range(2)])
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        if errors:
+            raise RuntimeError(f"overload bench traffic errored: "
+                               f"{errors[0]!r}")
+
+        final = np.asarray(table.get())
+        shard_stats = [mv.stats(ep, timeout=30.0)
+                       for ep in group.endpoints]
+        shed_srv = sum(s.counter("SHED_ADDS") for s in shard_stats)
+        drops = sum(s.counter("DEADLINE_EXPIRED_DROPS")
+                    for s in shard_stats)
+        lost = 0
+        for shard, stats in enumerate(shard_stats):
+            applied = int(round(float(
+                final[shard * span:(shard + 1) * span].sum()) / cols))
+            shed = (stats.counter("SHED_ADDS")
+                    + stats.counter("DEADLINE_EXPIRED_DROPS"))
+            lost += abs(completions[shard] - applied - shed)
+        attempted = sum(completions)
+        client.close()
+        return {
+            "overload_seconds": seconds,
+            "overload_zipf_s": zipf_s,
+            "overload_add_completions": attempted,
+            "overload_adds_shed": int(shed_srv),
+            "overload_shed_rate": round(
+                shed_srv / attempted, 4) if attempted else 0.0,
+            "overload_serving_get_p99_ms": round(float(
+                np.percentile(read_lat, 99)) * 1e3, 3) if read_lat
+                else 0.0,
+            "overload_training_add_p99_ms": round(float(
+                np.percentile(add_lat, 99)) * 1e3, 3) if add_lat
+                else 0.0,
+            "overload_serving_gets": len(read_lat),
+            "overload_deadline_drops": int(drops),
+            "overload_retry_budget_denials": int(
+                Dashboard.counter_value("RETRY_BUDGET_DENIALS")),
+            "overload_breaker_trips": int(
+                Dashboard.counter_value("BREAKER_TRIPS")),
+            "overload_client_adds_shed": int(
+                Dashboard.counter_value("CLIENT_ADDS_SHED")),
+            "overload_stalled_replies": int(
+                shard_stats[1].counter("FAULT_INJECTED_STALL")),
+            "overload_acked_adds_lost": int(lost),
+        }
+    finally:
+        group.stop()
+        os.environ.pop("MV_CHAOS_SHARD", None)
+        os.environ.pop("MV_CHAOS_SPEC", None)
+
+
 def probe_gbps(probe_mb=128):
     """Achieved-HBM-bandwidth probe (quiet chip ~760+ GB/s): a short
     donated-pass loop, min-of-3. ~1s; the load thermometer every gated
@@ -1954,6 +2101,13 @@ if __name__ == "__main__":
         print(json.dumps(_single_leg_result(
             {"metric": "autopilot_time_to_split_seconds",
              **bench_autopilot()})))
+    elif "--overload-bench" in sys.argv[1:]:
+        # overload-survival leg only (`make overload` drill / operators):
+        # train-while-serve storm with a stalled shard; reports shed
+        # rate, per-lane p99s, retry-budget denials, acked-Add loss
+        print(json.dumps(_single_leg_result(
+            {"metric": "overload_serving_get_p99_ms",
+             **bench_overload()})))
     elif "--compare" in sys.argv[1:]:
         # regression diff of two result files (CI runs non-blocking)
         sys.exit(_run_compare(sys.argv))

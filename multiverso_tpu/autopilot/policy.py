@@ -19,7 +19,8 @@ cleverness:
   it NOT act" as precisely as "why did it act".
 
 Priority order (first match wins): hot-shard split > cold-range merge >
-add replica (read-tier pressure) > remove replica (idle fleet) >
+add replica (read-tier pressure or admission shedding) >
+remove replica (idle fleet) >
 tier budget up (hot-tier misses) > tier budget down (over-provisioned).
 Splits and merges are topology changes and therefore marked ``risky``
 — the actuator rehearses them on a blue/green clone first when
@@ -167,7 +168,13 @@ class AutopilotPolicy:
         # WAL and cures nothing; it lands as a rejected alternative so
         # the recorder shows the controller saw it and declined.
         counts = sense.replica_counts or [0]
-        pressured = sense.read_pressure > self.hedge_rate
+        # Sustained admission-control shedding is the strongest overload
+        # signal there is: the gate is already sacrificing training
+        # writes to keep serving reads inside SLO, so capacity — not
+        # tuning — is the cure. Hysteresis still applies, so one stray
+        # shed event never resizes the fleet.
+        shedding = sense.shed_rate > 0.0
+        pressured = sense.read_pressure > self.hedge_rate or shedding
         target = (min(range(len(counts)), key=lambda k: counts[k])
                   if counts else 0)
         room = counts and counts[target] < self.max_replicas
@@ -182,17 +189,16 @@ class AutopilotPolicy:
                 {"action": "add_replica",
                  "reason": "replica lag is replay backlog, not serving "
                            "capacity — a new replica tails the same WAL"})
+        why = (f"admission gate shedding {sense.shed_rate:.1f} req/s"
+               if shedding else
+               f"read pressure {sense.read_pressure:.1f}/s over "
+               f"the {self.hedge_rate:.1f}/s threshold")
         if self._gate("add_replica", pressured and bool(room), now,
-                      decision,
-                      f"read pressure {sense.read_pressure:.1f}/s over "
-                      f"the {self.hedge_rate:.1f}/s threshold"):
+                      decision, why):
             decision.action = "add_replica"
             decision.shard = target
-            decision.reason = (f"read pressure "
-                               f"{sense.read_pressure:.1f}/s sustained "
-                               f"over {self.hedge_rate:.1f}/s; shard "
-                               f"{target} has the thinnest fleet "
-                               f"({counts[target]})")
+            decision.reason = (f"{why} sustained; shard {target} has "
+                               f"the thinnest fleet ({counts[target]})")
             return decision
 
         # 4. remove replica: idle fleet above the floor

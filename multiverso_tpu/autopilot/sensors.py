@@ -39,6 +39,7 @@ class FleetSense:
     shard_rates: List[float] = field(default_factory=list)
     total_qps: float = 0.0
     read_pressure: float = 0.0      # hedges+refusals+fallbacks per sec
+    shed_rate: float = 0.0          # SHED_ADDS+SHED_GETS per sec
     replica_lag: Dict[int, int] = field(default_factory=dict)
     replica_counts: List[int] = field(default_factory=list)
     get_p99: float = 0.0
@@ -51,6 +52,7 @@ class FleetSense:
         return {"now": self.now, "shard_rates": list(self.shard_rates),
                 "total_qps": self.total_qps,
                 "read_pressure": self.read_pressure,
+                "shed_rate": self.shed_rate,
                 "replica_lag": dict(self.replica_lag),
                 "replica_counts": list(self.replica_counts),
                 "get_p99": self.get_p99,
@@ -106,6 +108,14 @@ class FleetSensors:
                                 "READ_REPLICA_REFUSALS_SEEN",
                                 "READ_PRIMARY_FALLBACKS"))
 
+    def shed_rate(self) -> float:
+        """Admission-control refusals per second (both lanes): a sustained
+        non-zero rate means the fleet is in brownout — the overload gate
+        (docs/fault_tolerance.md) is actively trading training writes for
+        serving-read latency, and adding replicas or shards is the fix."""
+        return sum(self.recorder.rate(name, self.window)
+                   for name in ("SHED_ADDS", "SHED_GETS"))
+
     def replica_lag(self) -> Dict[int, int]:
         """Worst replay lag (records) per shard, probed concurrently
         over the slot-free watermark RPC; unreachable replicas are
@@ -159,6 +169,7 @@ class FleetSensors:
             shard_rates=rates,
             total_qps=sum(rates),
             read_pressure=self.read_pressure(),
+            shed_rate=self.shed_rate(),
             replica_lag=self.replica_lag(),
             replica_counts=counts,
             get_p99=self.recorder.quantile("CLIENT_REQUEST_SECONDS",

@@ -523,3 +523,50 @@ define_string("wal_sync", "batch",
               "tail can be lost even to a process crash), batch (flush to "
               "the OS — survives kill -9, not power loss; the default), "
               "always (fsync — survives power loss, slowest)")
+define_double("request_deadline_seconds", 0.0,
+              "per-request deadline budget clients stamp on correlated "
+              "requests (Get/Add/Read); it rides the wire header as "
+              "REMAINING microseconds, re-anchored on each receiver's "
+              "monotonic clock, and the server dispatcher drops expired "
+              "work at drain time with deadline_exceeded instead of "
+              "applying it. 0 = no deadline (legacy peers' 0-stamped "
+              "frames are likewise never refused)")
+define_bool("priority_lanes", True,
+            "stably sort each dispatcher drain into lanes: serving reads "
+            "(admin/slot-free Gets) > control > training traffic. Stable "
+            "within a lane, so per-worker FIFO is preserved; forced off "
+            "on the deterministic server (arrival-order WAL contract)")
+define_int("admission_queue_limit", 0,
+           "dispatcher backlog (messages) above which the admission gate "
+           "sheds wire training writes with a truthful 'shed: ...' reply "
+           "(serving reads shed only at 4x this limit — brownout before "
+           "blackout). 0 disables backlog shedding")
+define_string("tenant_quota_spec", "",
+              "per-tenant write-admission quotas keyed by table "
+              "namespace: ';'-separated "
+              "name:tables=<id>|<id>,qps=<rate>[,burst=<cap>] entries — "
+              "a tenant that exhausts its token bucket has its own Adds "
+              "shed (TENANT_<name>_SHED) without touching other tenants "
+              "or the serving lane. Empty = no quotas")
+define_double("retry_budget_tokens", 0.0,
+              "per-connection retry budget: token bucket capacity spent "
+              "by retransmits, read hedges, and layout re-fetches, "
+              "refilled retry_budget_ratio per success — under overload "
+              "retry pressure decays to the refill rate instead of "
+              "storming. A denial defers the retry (never fails the "
+              "request) and counts RETRY_BUDGET_DENIALS. 0 = unlimited")
+define_double("retry_budget_ratio", 0.1,
+              "retry-budget refill per successful reply (tokens); the "
+              "steady-state retry rate is bounded at this fraction of "
+              "the success rate")
+define_int("breaker_failures", 0,
+           "consecutive request failures (retransmit timeouts, "
+           "connection-loss recoveries) that trip a client connection's "
+           "circuit breaker open: writes fail fast with a truthful "
+           "'circuit open' error and reads stop falling back to the "
+           "primary (replicas keep serving) until a half-open probe "
+           "succeeds. 0 disables the breaker")
+define_double("breaker_reset_seconds", 5.0,
+              "how long a tripped breaker stays open before admitting "
+              "one half-open probe; the probe's outcome closes or "
+              "re-opens it")

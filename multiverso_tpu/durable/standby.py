@@ -62,6 +62,7 @@ from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.runtime.contracts import slot_free
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
+from multiverso_tpu.utils.backoff import Backoff
 
 _PRIMARY = 0  # the lease id the primary is tracked under
 
@@ -293,9 +294,10 @@ class WarmStandby:
         """Connection loss: redial while the lease is still live. Success
         triggers a fresh full-state transfer — records missed during the
         blip are covered by the new snapshot."""
-        while (not self._stop.is_set()
-               and not self._detector.is_evicted(_PRIMARY)):
-            time.sleep(0.2)
+        bo = Backoff(base=0.2, cap=2.0, cancel=self._stop)
+        while not self._detector.is_evicted(_PRIMARY):
+            if not bo.wait():
+                return  # _stop fired mid-sleep
             # re-check after the sleep: _failover sets _stop BEFORE binding
             # the service endpoint, so this cannot redial our own takeover
             # server and subscribe a stream nobody will ever read
@@ -480,17 +482,16 @@ class WarmStandby:
         self._zoo._dedup_seeds = list(self._seeds)
         # the dead primary's port can linger for a beat while the kernel
         # tears the old socket down — retry the bind briefly
-        deadline = time.monotonic() + 15.0
+        bo = Backoff(base=0.2, cap=1.0, deadline=time.monotonic() + 15.0)
         while True:
             try:
                 self.endpoint = mv.serve(self._service_endpoint)
                 break
             except OSError as exc:
-                if time.monotonic() >= deadline:
+                if not bo.wait():
                     log.error("standby: could not bind %s after failover: "
                               "%r", self._service_endpoint, exc)
                     raise
-                time.sleep(0.2)
         self.took_over.set()
         log.info("standby: serving on %s — clients resume via their "
                  "reconnect path", self.endpoint)
@@ -617,6 +618,13 @@ class ReplicaReadServer:
 
     @slot_free
     def _serve_read(self, msg: Message) -> None:
+        if 0.0 < msg.deadline < time.monotonic():
+            # the caller's budget is gone: serving would burn a replay-
+            # serialized gather on an answer nobody is waiting for
+            count("DEADLINE_EXPIRED_DROPS")
+            self._reply_error(msg, "deadline_exceeded: read expired "
+                                   "before the replica served it")
+            return
         refusal = self._refusal(int(msg.watermark))
         if refusal is not None:
             count("REPLICA_READ_REFUSALS")

@@ -16,11 +16,17 @@ FIRES wins, non-firing matches still advance that rule's counter)::
     reorder:dst=0,after=4                 # hold a frame, release behind the next
     partition:src=1,dst=0                 # one-way: rank 1 can never reach rank 0
     corrupt:type=Request_Add,every=6      # seeded bit-flip in the blob payload
+    stall:dst=0,seconds=0.2               # gray failure: drip one frame per 0.2s
 
 Predicates: ``src= dst= table=`` (ints), ``type=`` (MsgType name or int).
 Limiters: ``first=N`` (only the first N matches), ``after=N`` (skip the
 first N), ``every=N`` (every Nth), ``prob=p`` (seeded coin, applied last).
 ``delay``/``reorder`` take ``seconds=`` (delay duration / hold fallback).
+``stall`` is the slow-but-alive gray failure the breaker/deadline drills
+need: matching frames enter a per-connection drip queue that releases ONE
+frame every ``seconds=`` — head-of-line blocking included, unlike
+``delay`` whose timers run concurrently. The peer stays connected and
+correct, just pathologically slow.
 
 Any existing test or bench runs under chaos by setting the flags — the
 remote client/server build their transports through :func:`make_net`.
@@ -39,7 +45,8 @@ from multiverso_tpu.dashboard import count
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.runtime.net import _HEADER, TcpNet
 
-_ACTIONS = ("drop", "delay", "dup", "reorder", "partition", "corrupt")
+_ACTIONS = ("drop", "delay", "dup", "reorder", "partition", "corrupt",
+            "stall")
 
 
 @dataclass
@@ -180,6 +187,10 @@ class ChaosNet(TcpNet):
         self._injector = injector
         self._held: Dict[object, List[_Held]] = {}
         self._held_lock = threading.Lock()
+        # stall drip queues: key -> FIFO of deferred sends; one timer
+        # chain per key releases one frame per rule.seconds
+        self._stalled: Dict[object, List] = {}
+        self._stall_lock = threading.Lock()
 
     # -- intercepted send paths ---------------------------------------------
     def _send(self, msg: Message, channel: int) -> int:
@@ -231,12 +242,45 @@ class ChaosNet(TcpNet):
         if rule.action == "delay":
             self._later(rule.seconds, send)
             return 0
+        if rule.action == "stall":
+            # gray failure: the peer is alive but drips — matching frames
+            # queue per-connection and release ONE per rule.seconds, so
+            # later stalled frames wait behind earlier ones (head-of-line
+            # blocking, the signature a breaker must distinguish from a
+            # dead peer)
+            log.debug("chaos: stall frame %s->%s %s (%.3fs drip)",
+                      msg.src, msg.dst, msg.type, rule.seconds)
+            self._stall(key, send, rule.seconds)
+            return 0
         # reorder: hold; the next frame to this destination overtakes it
         held = _Held(send)
         with self._held_lock:
             self._held.setdefault(key, []).append(held)
         self._later(rule.seconds, held.release)
         return 0
+
+    def _stall(self, key, send, seconds: float) -> None:
+        with self._stall_lock:
+            q = self._stalled.setdefault(key, [])
+            q.append(send)
+            if len(q) > 1:
+                return  # a drip chain for this key is already running
+        self._later(seconds, lambda: self._drip(key, seconds))
+
+    def _drip(self, key, seconds: float) -> None:
+        with self._stall_lock:
+            q = self._stalled.get(key)
+            if not q:
+                return
+            send = q.pop(0)
+            more = bool(q)
+        try:
+            send()
+        except OSError as exc:
+            log.debug("chaos: stalled frame lost with its connection: %r",
+                      exc)
+        if more:
+            self._later(seconds, lambda: self._drip(key, seconds))
 
     def _release_held(self, key) -> None:
         with self._held_lock:

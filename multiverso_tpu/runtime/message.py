@@ -195,6 +195,19 @@ class Message:
     # req_id != 0; the flag's job is propagation and the read tier's
     # primary watermark-confirm leg.
     trace: bool = False
+    # Absolute deadline in LOCAL time.monotonic() seconds (0.0 = none).
+    # Never crosses a process boundary as an absolute instant — the wire
+    # header (runtime/net.py v5) carries the REMAINING budget in
+    # microseconds, and each receiver re-anchors it against its own
+    # monotonic clock, so wall-clock skew between hosts cannot expire (or
+    # resurrect) a request. Each hop that re-encodes the frame decrements
+    # the budget by its own queueing + transit time for free. Consumers:
+    # the server dispatcher drops expired work at drain time
+    # (deadline_exceeded) instead of burning an apply nobody awaits;
+    # forwarding hops (shard router parts, read-tier forwards) copy it
+    # onto derived requests. 0.0 ("legacy peer / no deadline") is never
+    # refused. Replies don't carry it — by reply time the wait is over.
+    deadline: float = 0.0
     data: List[Any] = field(default_factory=list)
 
     def create_reply(self) -> "Message":

@@ -50,6 +50,7 @@ from multiverso_tpu.dashboard import gauge_set, monitor
 from multiverso_tpu.obs.trace import hop
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.runtime.net import _tune_socket
+from multiverso_tpu.utils.backoff import Backoff
 
 # flags: multihost_endpoint / multihost_timeout / multihost_token (defined
 # in config.py so they exist before this module is first imported)
@@ -706,6 +707,7 @@ class MultihostRuntime:
                 self._threads.append(t)
         else:
             sock = None
+            bo = Backoff(base=0.1, cap=1.0, deadline=deadline)
             while True:
                 try:
                     sock = socket.create_connection(
@@ -713,13 +715,14 @@ class MultihostRuntime:
                         timeout=max(1.0, deadline - time.monotonic()))
                     break
                 except OSError:
-                    # the leader may not have bound yet — retry until the
-                    # handshake window closes
-                    if time.monotonic() >= deadline:
+                    # the leader may not have bound yet — retry on the
+                    # shared jittered backoff until the handshake window
+                    # closes (jitter matters here: every follower in the
+                    # job races the same bind)
+                    if not bo.wait():
                         log.fatal("multihost: cannot reach leader at %s "
                                   "within %.0fs", self._endpoint,
                                   self._timeout)
-                    time.sleep(0.1)
             _tune_socket(sock)
             sock.settimeout(max(1.0, deadline - time.monotonic()))
             sock.sendall(_hello_frame(self.rank, self.world))

@@ -51,13 +51,19 @@ _MAGIC = 0x4D565450  # 'MVTP'
 # DISCARDED (the length keeps the stream in sync; retransmit + the dedup
 # window recover the frame) instead of desyncing on a garbled blob size;
 # v4 grew the watermark field (read-replica tier: WAL record sequence on
-# replies/records, staleness budget on Request_Read frames).
+# replies/records, staleness budget on Request_Read frames); v5 grew the
+# deadline budget field — the REMAINING microseconds a request's caller
+# will keep waiting (0 = no deadline, never refused). A budget, not an
+# instant: each receiver re-anchors it against its own monotonic clock
+# (wall-clock skew between hosts cannot expire a request), and each hop
+# that re-encodes the frame ships only what's left after its own queueing,
+# so the budget decrements across hops for free.
 # Both sides of every deployment ship from this repo, so a mismatch is a
 # config error and the connection is dropped loudly rather than negotiated.
-_VERSION = 4
+_VERSION = 5
 # magic, version, channel, src, dst, type, table, msg_id, req_id,
-# watermark, nblobs, payload_len, crc32(payload)
-_HEADER = struct.Struct("<IBBiiiiqqqiqI")
+# watermark, deadline_us, nblobs, payload_len, crc32(payload)
+_HEADER = struct.Struct("<IBBiiiiqqqiiqI")
 _BLOB = struct.Struct("<B8sq")  # ndim, dtype str (padded), nbytes
 
 # One vectored syscall carries at most this many iovec segments — well
@@ -384,10 +390,22 @@ class TcpNet:
         # (shm rings) inherit it for free
         wire_channel = channel | (0x80 if getattr(msg, "trace", False)
                                   else 0)
+        # deadline rides as REMAINING budget (µs): measured against this
+        # sender's clock at encode time, so queueing spent here is already
+        # subtracted. An expired-at-encode deadline ships as the 1 µs
+        # floor — the receiver drops it at drain with a truthful
+        # deadline_exceeded instead of this layer silently eating it.
+        deadline_us = 0
+        local_deadline = getattr(msg, "deadline", 0.0)
+        if local_deadline > 0:
+            deadline_us = max(
+                1, min(0x7FFFFFFF,
+                       int((local_deadline - time.monotonic()) * 1e6)))
         segments[0] = _HEADER.pack(_MAGIC, _VERSION, wire_channel, msg.src,
                                    msg.dst, int(msg.type), msg.table_id,
                                    msg.msg_id, msg.req_id, msg.watermark,
-                                   len(msg.data), payload_len, crc)
+                                   deadline_us, len(msg.data), payload_len,
+                                   crc)
         observe("FRAME_ENCODE_SECONDS", time.perf_counter() - t0)
         return segments, _HEADER.size + payload_len
 
@@ -692,7 +710,8 @@ class TcpNet:
         :class:`_WireDesync` on an unparsable header."""
         head = read(_HEADER.size)
         (magic, version, channel, src, dst, mtype, table_id, msg_id,
-         req_id, watermark, nblobs, payload_len, crc) = _HEADER.unpack(head)
+         req_id, watermark, deadline_us, nblobs, payload_len,
+         crc) = _HEADER.unpack(head)
         # the channel byte's high bit is the trace flag — mask it off
         # before routing (the raw channel's == 1 check must still hold)
         trace = bool(channel & 0x80)
@@ -737,6 +756,10 @@ class TcpNet:
                       table_id=table_id, msg_id=msg_id,
                       req_id=req_id, watermark=watermark, trace=trace,
                       data=blobs)
+        if deadline_us > 0:
+            # re-anchor the remaining budget on THIS process's monotonic
+            # clock — absolute instants never cross the wire
+            msg.deadline = time.monotonic() + deadline_us / 1e6
         msg._wire_channel = channel
         return msg
 

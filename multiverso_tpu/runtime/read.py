@@ -258,6 +258,11 @@ class ReplicaReader:
         self.dead_until = 0.0
         self.stale_until = 0.0
         self._compress = bool(config.get_flag("wire_compression"))
+        # deadline budget stamped on each Request_Read (0 = none): a
+        # replica drowning in reads drops the expired ones at drain
+        # instead of serving answers nobody is waiting for
+        self._deadline_budget = float(
+            config.get_flag("request_deadline_seconds"))
         self._closed = False
 
     def available(self, now: float) -> bool:
@@ -304,6 +309,8 @@ class ReplicaReader:
                       table_id=table_id, msg_id=msg_id,
                       req_id=int(req_id), trace=bool(trace),
                       watermark=int(budget),
+                      deadline=(time.monotonic() + self._deadline_budget
+                                if self._deadline_budget > 0 else 0.0),
                       data=wire.encode(request, compress=self._compress))
         try:
             self._ensure_net().send(msg)
@@ -432,9 +439,14 @@ class ReadRouter:
                  budget: Optional[int] = None,
                  cache_bytes: Optional[int] = None,
                  req_id_source: Optional[Callable[[], int]] = None,
-                 watermark_confirm: Optional[Callable[[int], None]] = None
-                 ) -> None:
+                 watermark_confirm: Optional[Callable[[int], None]] = None,
+                 retry_budget: Optional[object] = None) -> None:
         self.preference = validate_read_preference(preference)
+        # shared per-connection retry budget (fault/retry.py RetryBudget
+        # or None): hedges are retries in the budget's ledger — a dry
+        # bucket skips the hedge (the first fire still runs), so hedging
+        # pressure decays with the success rate under overload
+        self.retry_budget = retry_budget
         self.budget = int(budget if budget is not None
                           else config.get_flag("read_staleness_records"))
         self._primary_submit = primary_submit
@@ -598,6 +610,11 @@ class _ReadAttempt:
             if self._settled or self._hedged:
                 return
             self._hedged = True
+        budget = self._router.retry_budget
+        if budget is not None and not budget.allow():
+            return  # dry retry budget: the first fire keeps running,
+            # only the speculative second copy is skipped (denial counted
+            # by the budget)
         count("READ_HEDGES")
         if not self._fire_next():
             # no second replica available: hedge against the primary

@@ -53,7 +53,8 @@ from multiverso_tpu import io as mv_io
 from multiverso_tpu.dashboard import Dashboard, count, gauge_set, observe
 from multiverso_tpu.fault.detector import LivenessDetector
 from multiverso_tpu.fault.inject import make_net
-from multiverso_tpu.fault.retry import RetryPolicy
+from multiverso_tpu.fault.retry import (CircuitBreaker, RetryBudget,
+                                        RetryPolicy)
 from multiverso_tpu.obs.metrics import StatsSnapshot
 from multiverso_tpu.obs.trace import flight_dump, hop
 from multiverso_tpu.runtime.contracts import slot_free
@@ -130,7 +131,11 @@ class _NetCompletion:
         self._reply(reply_type, result)
 
     def fail(self, error: BaseException) -> None:
-        self._reply(MsgType.Reply_Error, repr(error))
+        # admission refusals and deadline drops ship their exact truthful
+        # string (clients key graceful degradation on the "shed: " /
+        # "deadline_exceeded" prefixes); everything else ships its repr
+        self._reply(MsgType.Reply_Error,
+                    getattr(error, "wire_text", None) or repr(error))
 
 
 class _ReadCompletion:
@@ -165,7 +170,8 @@ class _ReadCompletion:
         self._reply(MsgType.Reply_Read, result)
 
     def fail(self, error: BaseException) -> None:
-        self._reply(MsgType.Reply_Error, repr(error))
+        self._reply(MsgType.Reply_Error,
+                    getattr(error, "wire_text", None) or repr(error))
 
 
 class RemoteServer:
@@ -588,7 +594,7 @@ class RemoteServer:
         # defer/release, WAL append, apply) land on the request's trace
         forward = Message(
             src=msg.src, dst=-1, type=msg.type, table_id=msg.table_id,
-            msg_id=msg.msg_id, req_id=msg.req_id,
+            msg_id=msg.msg_id, req_id=msg.req_id, deadline=msg.deadline,
             data=[request, completion])
         if (msg.type == MsgType.Request_Add and msg.req_id
                 and self._zoo.server.wal is not None):
@@ -615,6 +621,7 @@ class RemoteServer:
         self._zoo.server.send(Message(
             src=-1, dst=-1, type=MsgType.Request_Get,
             table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
+            deadline=msg.deadline,
             data=[request, completion]))
 
     @slot_free
@@ -1076,6 +1083,15 @@ class RemoteClient:
         self._stop_maint = threading.Event()
         self._hb_period = float(config.get_flag("heartbeat_seconds"))
         self._rto = float(config.get_flag("request_retry_seconds"))
+        # overload survival (fault/retry.py): deadline budget stamped on
+        # every correlated request (0 = none), a success-refilled retry
+        # budget governing retransmits + read hedges, and a circuit
+        # breaker that fails writes fast while the server is suspect.
+        # Defaults leave all three inert.
+        self._deadline_budget = float(
+            config.get_flag("request_deadline_seconds"))
+        self._retry_budget = RetryBudget.from_flags()
+        self._breaker = CircuitBreaker.from_flags()
         # set BEFORE the pump starts (the pump observes reply watermarks
         # through it); the router itself is built after registration
         self._read_router = None
@@ -1108,7 +1124,8 @@ class RemoteClient:
                     self._confirm_watermark
                     if self._trace
                     and bool(config.get_flag("trace_read_confirm"))
-                    else None))
+                    else None),
+                retry_budget=self._retry_budget)
         self._start_maintenance()
 
     # -- lifecycle -----------------------------------------------------------
@@ -1198,10 +1215,13 @@ class RemoteClient:
 
     def _send(self, table_id: int, msg_type: MsgType, request: Any,
               msg_id: int, completion: Optional[Completion],
-              direct: bool = False, watermark: int = -1) -> int:
+              direct: bool = False, watermark: int = -1,
+              deadline: Optional[float] = None) -> int:
         """Returns the req_id the request was issued under (0 for
         fire-and-forget posts) so callers a layer up — the shard router —
-        can append their own hops to the same trace."""
+        can append their own hops to the same trace. ``deadline`` is an
+        absolute monotonic instant (None = mint one from the
+        request_deadline_seconds flag; 0.0 = explicitly none)."""
         if self._read_router is not None and not direct:
             if (msg_type == MsgType.Request_Get and completion is not None
                     and self._read_tier_ok(table_id)):
@@ -1211,11 +1231,37 @@ class RemoteClient:
                 # this client just changed the table: its cached reads of
                 # it are suspect (write-through invalidation)
                 self._read_router.note_local_write(table_id)
+        if completion is not None and msg_type in (MsgType.Request_Get,
+                                                   MsgType.Request_Add):
+            if deadline is None:
+                deadline = (time.monotonic() + self._deadline_budget
+                            if self._deadline_budget > 0 else 0.0)
+            if deadline > 0 and deadline <= time.monotonic():
+                # the caller's budget is already gone: spending a round
+                # trip to learn that would be the overload amplifier this
+                # layer exists to remove
+                count("DEADLINE_EXPIRED_AT_SEND")
+                completion.fail(RuntimeError(
+                    f"deadline_exceeded: {msg_type.name} expired before "
+                    "send"))
+                return 0
+            if not self._breaker.allow():
+                # tripped breaker: fail fast with the truth instead of
+                # queueing onto a server we believe is down. Replica-
+                # routed Gets never reach here — they were submitted to
+                # the read tier above.
+                count("BREAKER_FAST_FAILS")
+                completion.fail(RuntimeError(
+                    "circuit open: server connection suspect after "
+                    "consecutive failures; failing fast (half-open probe "
+                    f"in <= {self._breaker.reset_seconds:.1f}s)"))
+                return 0
         data = [] if request is None and msg_type not in (
             MsgType.Request_Get, MsgType.Request_Add) else wire.encode(
                 request, compress=self._compress)
         msg = Message(src=self.worker_id, dst=0, type=msg_type,
                       table_id=table_id, msg_id=msg_id,
+                      deadline=deadline if deadline is not None else 0.0,
                       req_id=self._next_req_id() if completion is not None
                       else 0,
                       # a shard router stamps its layout version here so a
@@ -1273,6 +1319,11 @@ class RemoteClient:
                 gauge_set("CLIENT_INFLIGHT", len(self._inflight))
             if completion is None:
                 continue  # duplicate reply (retransmit + dedup): settled
+            # ANY correlated reply — success or server-side error — proves
+            # the connection lives: refill the retry budget, feed the
+            # breaker (its failure signal is silence, not error payloads)
+            self._retry_budget.on_success()
+            self._breaker.record_success()
             if flight is not None:
                 # end-to-end request latency, retransmits included — the
                 # distribution mv.stats() reports as CLIENT_REQUEST_SECONDS
@@ -1281,8 +1332,19 @@ class RemoteClient:
             hop(msg.req_id, "client_reply")
             try:
                 if msg.type == MsgType.Reply_Error:
-                    completion.fail(RuntimeError(
-                        f"server-side failure: {wire.decode(msg.data)}"))
+                    text = wire.decode(msg.data)
+                    if (isinstance(text, str) and text.startswith("shed:")
+                            and flight is not None
+                            and flight.msg.type == MsgType.Request_Add):
+                        # admission-shed training write: the graceful-
+                        # degradation contract — the delta is DROPPED (a
+                        # lost async gradient, Downpour-tolerated), the
+                        # caller is not errored, the shed is counted
+                        count("CLIENT_ADDS_SHED")
+                        completion.done(None)
+                    else:
+                        completion.fail(RuntimeError(
+                            f"server-side failure: {text}"))
                 elif msg.type == MsgType.Reply_WrongShard:
                     refusal = wire.decode(msg.data)
                     completion.fail(WrongShardError(
@@ -1299,6 +1361,8 @@ class RemoteClient:
 
     # -- fault recovery ------------------------------------------------------
     def _start_recovery(self) -> None:
+        # connection loss is the strongest failure signal the breaker gets
+        self._breaker.record_failure()
         with self._recover_lock:
             if self._recovering or self._closed:
                 return
@@ -1403,11 +1467,21 @@ class RemoteClient:
         with self._lock:
             if self._recovering:
                 return
-            stale = [f for f in self._inflight.values()
-                     if now - f.sent >= self._rto * min(2 ** f.attempts, 16)]
-            for flight in stale:
-                flight.attempts += 1
-                flight.sent = now
+            stale = []
+            for f in self._inflight.values():
+                if now - f.sent < self._rto * min(2 ** f.attempts, 16):
+                    continue
+                # every overdue reply is a failure datapoint for the
+                # breaker whether or not the retransmit is admitted
+                self._breaker.record_failure()
+                if not self._retry_budget.allow():
+                    # dry retry budget DEFERS (never fails): sent/attempts
+                    # stay put, so the flight re-qualifies next tick and
+                    # retries once successes refill the bucket
+                    break
+                f.attempts += 1
+                f.sent = now
+                stale.append(f)
         for flight in stale:
             count("CLIENT_RETRIES")
             hop(flight.msg.req_id, "client_retransmit")

@@ -28,6 +28,8 @@ from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_set, monitor, observe
 from multiverso_tpu.obs.profiler import clear_wait, mark_wait
 from multiverso_tpu.obs.trace import flight_dump, hop
+from multiverso_tpu.runtime.admission import (AdmissionGate, DeadlineExceeded,
+                                              ShedError, lane_order)
 from multiverso_tpu.runtime.contracts import dispatcher_only
 from multiverso_tpu.runtime.message import Message, MsgType
 from multiverso_tpu.utils import MtQueue
@@ -120,6 +122,13 @@ class Server:
     # (round, worker) ordering admits no compatible multi-message group,
     # so they apply per message exactly as before.
     fuses_adds = True
+    # True on servers whose drain may stably sort a drained batch into
+    # priority lanes (serving reads > control > training writes). The
+    # deterministic server keeps it False: its WAL is appended in ARRIVAL
+    # order across workers and lane sorting would reorder that tape.
+    # Sync/SSP keep it True — their round clocks defer, not order, so a
+    # lane-sorted drain reaches the same gated state.
+    reorders_lanes = True
 
     @property
     def plain_async(self) -> bool:
@@ -162,6 +171,13 @@ class Server:
         # at construction like the wire coalescing caps
         self._apply_batch_cap = max(0, int(
             config.get_flag("apply_batch_msgs")))
+        # overload survival (runtime/admission.py): drain-time admission
+        # gate (backlog shedding, tenant write quotas, optional SLO burn
+        # signal attachable via gate.burn_signal) + lane sorting. Flags
+        # read once at construction; defaults admit everything.
+        self.admission = AdmissionGate.from_flags()
+        self._lane_sort = (self.reorders_lanes
+                           and bool(config.get_flag("priority_lanes")))
 
     def _ident(self) -> str:
         """Log prefix naming this dispatcher when it is one of many."""
@@ -253,11 +269,44 @@ class Server:
             # wakeup's batch; sampled once per drain, not once per message
             # (per-message sampling was pure hot-loop overhead)
             queue_gauge.set(self._queue.size())
+            if self._lane_sort and len(msgs) > 1:
+                msgs = lane_order(msgs)
+            msgs = self._admit(msgs)
             if fuse and len(msgs) > 1:
                 self._dispatch_batch(msgs)
             else:
                 for msg in msgs:
                     self._dispatch_guarded(msg)
+
+    def _admit(self, msgs: List[Message]) -> List[Message]:
+        """Drain-time overload filter: drop expired-deadline work (its
+        caller stopped waiting — an apply would be pure heat) and ask the
+        admission gate about the rest. Both failure paths answer the
+        completion truthfully (deadline_exceeded / "shed: ...") so the
+        client can distinguish 'degrade gracefully' from 'broken'. Depth
+        = this batch + what queued behind it, the backlog a new arrival
+        actually waits behind."""
+        depth = len(msgs) + self._queue.size()
+        now = time.monotonic()
+        admitted: List[Message] = []
+        for msg in msgs:
+            if 0.0 < msg.deadline < now and msg.type in (
+                    MsgType.Request_Get, MsgType.Request_Add):
+                count("DEADLINE_EXPIRED_DROPS")
+                hop(msg.req_id, "deadline_drop")
+                if msg.data and hasattr(msg.data[-1], "fail"):
+                    msg.data[-1].fail(DeadlineExceeded(
+                        f"deadline_exceeded: {msg.type.name} expired "
+                        f"{now - msg.deadline:.3f}s before apply "
+                        f"(backlog {depth})"))
+                continue
+            text = self.admission.refusal(msg, depth)
+            if text is not None:
+                if msg.data and hasattr(msg.data[-1], "fail"):
+                    msg.data[-1].fail(ShedError(text))
+                continue
+            admitted.append(msg)
+        return admitted
 
     def _dispatch_guarded(self, msg: Message) -> None:
         try:
@@ -449,6 +498,9 @@ class DeterministicServer(Server):
     # (round, worker) apply order admits no multi-message fused group:
     # the drain loop dispatches per message, exactly as before
     fuses_adds = False
+    # WAL/ACK happen at enqueue in ARRIVAL order — lane sorting would
+    # reorder that tape, so the deterministic drain keeps FIFO
+    reorders_lanes = False
 
     def __init__(self, num_workers: int) -> None:
         super().__init__(num_workers)

@@ -302,6 +302,16 @@ def main(argv=None) -> int:
 
     flags = dict(spec.get("flags", {}))
     flags["ps_role"] = "server"
+    # MV_CHAOS_SHARD=<k> + MV_CHAOS_SPEC=<fault DSL>: arm the chaos
+    # schedule on exactly ONE shard's primary — the gray-failure drill
+    # vehicle (the CI overload job stalls one shard's replies while its
+    # sibling serves clean; group-spec flags reach every child equally,
+    # so an asymmetric fault needs this env seam).
+    chaos_shard = os.environ.get("MV_CHAOS_SHARD", "")
+    if (chaos_shard != "" and int(chaos_shard) == shard
+            and not args.standby and args.replica < 0):
+        flags["fault_spec"] = os.environ.get("MV_CHAOS_SPEC", "")
+        flags.setdefault("fault_seed", 0)
     # fleet identity for labeled metrics (mvtpu_*{shard=,role=}) — the
     # role the child was launched AS, not what it may fail over into
     flags.setdefault("metrics_shard", shard)

@@ -39,6 +39,7 @@ from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import (RangePartitioner,
                                             partitioner_from_spec)
 from multiverso_tpu.updaters import AddOption, GetOption
+from multiverso_tpu.utils.backoff import Backoff
 
 LAYOUT_VERSION = 1
 
@@ -116,20 +117,23 @@ class ShardLayout:
             return cls(json.load(f))
 
 
-def fetch_layout(endpoint: str, timeout: float = 10.0) -> ShardLayout:
+def fetch_layout(endpoint: str, timeout: float = 10.0,
+                 budget: Optional[object] = None) -> ShardLayout:
     """One-shot layout RPC: any member of a shard group answers with the
     full manifest, so clients bootstrap from a single known endpoint (the
     reference's Controller broadcast, pull-shaped). Like the stats probe,
     this takes no worker slot and no lease.
 
-    Connection-level failures (refused, reset, probe timeout) retry with
-    exponential backoff inside ``timeout``: a client racing a group's
-    startup — or a migration's member churn — should wait out the bind
-    race, not fail on the first probe. A server-side REFUSAL (not a
-    shard-group member) still raises immediately."""
+    Connection-level failures (refused, reset, probe timeout) retry on
+    the shared jittered backoff (utils/backoff.py) inside ``timeout``: a
+    client racing a group's startup — or a migration's member churn —
+    should wait out the bind race, not fail on the first probe. A
+    server-side REFUSAL (not a shard-group member) still raises
+    immediately. ``budget`` (a fault/retry.py RetryBudget) gates the
+    re-fetches a layout-churn storm would otherwise amplify."""
     from multiverso_tpu.runtime.remote import control_probe
     deadline = time.monotonic() + timeout
-    delay = 0.05
+    bo = Backoff(base=0.05, cap=1.0, deadline=deadline, budget=budget)
     while True:
         remaining = deadline - time.monotonic()
         try:
@@ -139,13 +143,11 @@ def fetch_layout(endpoint: str, timeout: float = 10.0) -> ShardLayout:
                                     what="layout")
             return ShardLayout(payload)
         except OSError as exc:  # ConnectionError/TimeoutError included
-            if time.monotonic() + delay >= deadline:
+            if not bo.wait():
                 raise
             count("LAYOUT_FETCH_RETRIES")
-            log.debug("fetch_layout(%s): %r — retrying in %.2fs",
-                      endpoint, exc, delay)
-            time.sleep(delay)
-            delay = min(delay * 2, 1.0)
+            log.debug("fetch_layout(%s): %r — retrying (attempt %d)",
+                      endpoint, exc, bo.attempt)
 
 
 # -- split/merge (pure; the bit-identical contract lives here) ---------------
@@ -842,7 +844,7 @@ class ShardedClient:
             for shard, ep in enumerate(fresh.endpoints):
                 client = current.pop(ep, None)
                 if client is None:
-                    delay = 0.05
+                    bo = Backoff(base=0.05, cap=1.0, deadline=deadline)
                     while True:
                         try:
                             client = RemoteClient(
@@ -851,10 +853,8 @@ class ShardedClient:
                                 read_preference=self._read_pref)
                             break
                         except OSError:
-                            if time.monotonic() + delay >= deadline:
+                            if not bo.wait():
                                 raise
-                            time.sleep(delay)
-                            delay = min(delay * 2, 1.0)
                     fresh_clients.append(client)
                 clients.append(client)
         except BaseException:

@@ -42,7 +42,7 @@ def _legacy_frame(msg, channel=0):
     payload = b"".join(parts)
     header = _HEADER.pack(_MAGIC, _VERSION, channel, msg.src, msg.dst,
                           int(msg.type), msg.table_id, msg.msg_id,
-                          msg.req_id, msg.watermark, len(msg.data),
+                          msg.req_id, msg.watermark, 0, len(msg.data),
                           len(payload), zlib.crc32(payload))
     return header + payload
 
