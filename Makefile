@@ -65,6 +65,10 @@
 #   make overload-bench overload leg only: shed rate, per-lane p99s,
 #                       retry-budget denials, acked-Add conservation
 #                       under a stalled shard (BENCH_r11.json)
+#   make chargeback     per-tenant chargeback plane: tenant-resolved
+#                       tracing, cost attribution + labeled exposition,
+#                       burn-driven deadline tightening, and the live
+#                       two-tenant drill (docs/observability.md §15)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -73,10 +77,10 @@ CHAOS_SEED ?= 7
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
 	audit audit-bench autopilot autopilot-bench overload overload-bench \
-	clean
+	chargeback clean
 
 check: lint native test dryrun profile-smoke tiered audit autopilot \
-	overload bench
+	overload chargeback bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -161,6 +165,10 @@ overload:
 
 overload-bench:
 	$(CPU_ENV) $(PYTHON) bench.py --overload-bench
+
+chargeback:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_chargeback.py -q \
+		-p no:cacheprovider -p no:randomly
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

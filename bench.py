@@ -71,9 +71,11 @@ _ATTRIBUTE = False
 
 def _collect_leg_attribution(label, tables):
     """``--attribute``: decompose the traces the leg just left in the
-    local store into a critical-path table (obs/critpath.py), then clear
-    the store so the next leg attributes only its own traffic."""
+    local store into a critical-path table (obs/critpath.py) plus its
+    per-tenant chargeback split (obs/chargeback.py), then clear the
+    store so the next leg attributes only its own traffic."""
     try:
+        from multiverso_tpu.obs.chargeback import charge
         from multiverso_tpu.obs.collector import TraceCollector
         from multiverso_tpu.obs.critpath import attribute
         from multiverso_tpu.obs.trace import TRACES
@@ -84,6 +86,9 @@ def _collect_leg_attribution(label, tables):
         report = attribute(spans)
         if report.rows:
             tables[label] = report.to_dict()
+            chargeback = charge(spans)
+            if chargeback.rows:
+                tables[label]["chargeback"] = chargeback.to_dict()
     except Exception as exc:  # attribution must never sink the bench
         tables[label] = {"error": repr(exc)[:200]}
 
@@ -1622,6 +1627,12 @@ def bench_overload(rows=64, cols=8, seconds=6.0, zipf_s=1.2,
     mv.set_flag("retry_budget_ratio", 0.5)
     mv.set_flag("breaker_failures", 3)
     mv.set_flag("breaker_reset_seconds", 0.5)
+    tenant_spec = (f"train:tables=0,qps={tenant_qps},"
+                   f"burst={tenant_burst}")
+    # the spec must ALSO be set client-side (group flags reach only the
+    # child servers): the submit sites resolve it to tag every span for
+    # the chargeback table below
+    mv.set_flag("tenant_quota_spec", tenant_spec)
     group = ShardGroup(
         [{"kind": "matrix", "num_row": rows, "num_col": cols}],
         shards=2,
@@ -1629,8 +1640,7 @@ def bench_overload(rows=64, cols=8, seconds=6.0, zipf_s=1.2,
                "request_retry_seconds": 0.2,
                "request_deadline_seconds": 30.0,
                "admission_queue_limit": queue_limit,
-               "tenant_quota_spec":
-                   f"train:tables=0,qps={tenant_qps},burst={tenant_burst}",
+               "tenant_quota_spec": tenant_spec,
                "heartbeat_seconds": 0.2}).start()
     try:
         client = group.connect()
@@ -1706,6 +1716,22 @@ def bench_overload(rows=64, cols=8, seconds=6.0, zipf_s=1.2,
                     + stats.counter("DEADLINE_EXPIRED_DROPS"))
             lost += abs(completions[shard] - applied - shed)
         attempted = sum(completions)
+        # chargeback plane (BENCH_r12): per-tenant admit/shed splits off
+        # the TENANT_<t>_* families plus the tenant-partitioned
+        # critical-path table, so a multi-core run MEASURES isolation
+        from multiverso_tpu.dashboard import split_tenant
+        tenant_split = {}
+        for stats in shard_stats:
+            for name, value in stats.counters.items():
+                tenant, suffix = split_tenant(name)
+                if tenant is not None and suffix in ("ADMITTED", "SHED"):
+                    split = tenant_split.setdefault(
+                        tenant, {"admitted": 0, "shed": 0})
+                    split[suffix.lower()] += int(value)
+        try:
+            chargeback_table = mv.chargeback(group, timeout=30.0).to_dict()
+        except Exception as exc:  # noqa: BLE001 — never sink the bench
+            chargeback_table = {"error": repr(exc)[:200]}
         client.close()
         return {
             "overload_seconds": seconds,
@@ -1731,9 +1757,12 @@ def bench_overload(rows=64, cols=8, seconds=6.0, zipf_s=1.2,
             "overload_stalled_replies": int(
                 shard_stats[1].counter("FAULT_INJECTED_STALL")),
             "overload_acked_adds_lost": int(lost),
+            "overload_tenant_split": tenant_split,
+            "overload_chargeback": chargeback_table,
         }
     finally:
         group.stop()
+        mv.set_flag("tenant_quota_spec", "")
         os.environ.pop("MV_CHAOS_SHARD", None)
         os.environ.pop("MV_CHAOS_SPEC", None)
 

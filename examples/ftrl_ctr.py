@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Streaming CTR prediction with the FTRL table — the reference's
-``Applications/LogisticRegression`` FTRL mode as a runnable demo.
+``Applications/LogisticRegression`` FTRL mode as a runnable demo, and
+the chargeback plane's two-tenant demo.
 
 A click-through stream with a few informative features among many noise
 ones is fed through a logistic model whose weights live server-side in
@@ -10,11 +11,21 @@ materializes weights from the (z, n) accumulators on demand. The l1
 term drives noise-feature weights to EXACT zero — the model that comes
 back is sparse, which is the whole point of FTRL for CTR.
 
+The run doubles as the chargeback demo: alongside the local FTRL loop,
+the trainer publishes each refreshed weight vector over the wire to a
+publish table under tenant ``trainer`` while a concurrent model-server
+thread read-floods a serving table under tenant ``serving`` (the
+``tenant_quota_spec`` flag labels the tables), so the run ends with an
+``mv.chargeback`` table splitting the fleet's time, bytes and admitted
+requests between the two (docs/observability.md §Chargeback).
+
 Run:  python examples/ftrl_ctr.py
 """
 
 import os
 import sys
+import threading
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -51,23 +62,59 @@ def main(d=400, informative=16, n=12_000, batch=64, alpha=0.5, beta=1.0,
     Xte, yte = X[-2000:], y[-2000:]
     X, y = X[:-2000], y[:-2000]
 
-    mv.init()
+    # two tenant labels for the wire traffic (generous quotas — this is
+    # labeling, not enforcement): the trainer's weight-publish stream
+    # owns table 1, the model-server read flood owns table 2
+    mv.set_flag("tenant_quota_spec",
+                "trainer:tables=1,qps=1e6,burst=1e6;"
+                "serving:tables=2,qps=1e6,burst=1e6")
+    mv.init(remote_workers=1)
     mv.register_table_type("ftrl", FTRLWorker)
     table = mv.create_table("ftrl", d, alpha=alpha, beta=beta,
                             lambda1=lambda1, lambda2=lambda2)
+    mv.create_table("array", d, np.float32)  # table 1: published weights
+    mv.create_table("array", d, np.float32)  # table 2: serving features
+    endpoint = mv.serve("127.0.0.1:0")
+    client = mv.remote_connect(endpoint)
+    publish = client.table(1)
+    serving = client.table(2)
+
+    stop = threading.Event()
+
+    def read_flood():
+        # tenant "serving": a model-server polling its feature table
+        while not stop.is_set():
+            serving.get()
+            time.sleep(0.002)
+
+    flood = threading.Thread(target=read_flood, daemon=True,
+                             name="ctr-read-flood")
+    flood.start()
+
     baseline = _logloss(_sigmoid(Xte @ table.get()), yte)
+    w_published = np.zeros(d, np.float32)
     for start in range(0, len(X), batch):
         xb, yb = X[start:start + batch], y[start:start + batch]
         w = table.get()
         p = _sigmoid(xb @ w)
         table.add((xb.T @ (p - yb)) / len(yb))
+        # tenant "trainer": push the refreshed model to the publish table
+        publish.add(np.asarray(w - w_published, np.float32))
+        w_published = w
         if verbose and start % (batch * 50) == 0:
             print(f"samples {start}: streaming logloss "
                   f"{_logloss(p, yb):.4f}")
+    stop.set()
+    flood.join(timeout=5)
     w = table.get()
     final = _logloss(_sigmoid(Xte @ w), yte)
     sparsity = float((w == 0.0).mean())
+    if verbose:
+        # who bought which fraction of the machine this run
+        mv.chargeback([endpoint]).display()
+    client.close()
     mv.shutdown()
+    mv.set_flag("tenant_quota_spec", "")
     if verbose:
         print(f"held-out logloss: {baseline:.4f} -> {final:.4f}")
         print(f"final logloss: {final:.4f}")

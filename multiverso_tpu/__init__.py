@@ -512,6 +512,22 @@ def attribution(endpoints: Any, timeout: Optional[float] = None,
                              include_profiles=include_profiles)
 
 
+def chargeback(endpoints: Any, timeout: Optional[float] = None,
+               quantile: Optional[float] = None):
+    """Fleet cost attribution BY TENANT (``mv.chargeback``): pull +
+    stitch the fleet's tenant-tagged traces and partition the same
+    critical-path segments :func:`attribution` decomposes into a
+    per-tenant table — share-of-fleet-time (sums to ~1.0), apply+WAL
+    time, p99, bytes pushed, Adds admitted vs shed — the "which tenant
+    bought which fraction of the machine" answer
+    (docs/observability.md §Chargeback). Returns a
+    :class:`~multiverso_tpu.obs.chargeback.ChargebackReport`; call
+    ``.display()`` to print it."""
+    from multiverso_tpu.obs.chargeback import fleet_chargeback
+    return fleet_chargeback(_fleet_endpoints(endpoints), timeout=timeout,
+                            quantile=quantile)
+
+
 def top(endpoints: Any, timeout: Optional[float] = None,
         format: str = "text") -> str:
     """The live fleet view (``mv.top``): one stats+watermark probe per

@@ -40,6 +40,7 @@ class FleetSense:
     total_qps: float = 0.0
     read_pressure: float = 0.0      # hedges+refusals+fallbacks per sec
     shed_rate: float = 0.0          # SHED_ADDS+SHED_GETS per sec
+    tenant_shed_rates: Dict[str, float] = field(default_factory=dict)
     replica_lag: Dict[int, int] = field(default_factory=dict)
     replica_counts: List[int] = field(default_factory=list)
     get_p99: float = 0.0
@@ -53,6 +54,7 @@ class FleetSense:
                 "total_qps": self.total_qps,
                 "read_pressure": self.read_pressure,
                 "shed_rate": self.shed_rate,
+                "tenant_shed_rates": dict(self.tenant_shed_rates),
                 "replica_lag": dict(self.replica_lag),
                 "replica_counts": list(self.replica_counts),
                 "get_p99": self.get_p99,
@@ -116,6 +118,18 @@ class FleetSensors:
         return sum(self.recorder.rate(name, self.window)
                    for name in ("SHED_ADDS", "SHED_GETS"))
 
+    def tenant_shed_rates(self) -> Dict[str, float]:
+        """Per-tenant shed rate (``TENANT_<t>_SHED`` per second): the
+        disaggregation of :meth:`shed_rate` that stops one noisy tenant
+        masquerading as fleet-wide capacity pressure — a policy can see
+        that the shedding is confined to the tenant whose quota is doing
+        its job. Degrades to {} on recorders without the tenant view
+        (tests inject minimal fakes)."""
+        rates = getattr(self.recorder, "tenant_rates", None)
+        if rates is None:
+            return {}
+        return dict(rates("SHED", self.window))
+
     def replica_lag(self) -> Dict[int, int]:
         """Worst replay lag (records) per shard, probed concurrently
         over the slot-free watermark RPC; unreachable replicas are
@@ -170,6 +184,7 @@ class FleetSensors:
             total_qps=sum(rates),
             read_pressure=self.read_pressure(),
             shed_rate=self.shed_rate(),
+            tenant_shed_rates=self.tenant_shed_rates(),
             replica_lag=self.replica_lag(),
             replica_counts=counts,
             get_p99=self.recorder.quantile("CLIENT_REQUEST_SECONDS",

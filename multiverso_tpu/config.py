@@ -548,6 +548,13 @@ define_string("tenant_quota_spec", "",
               "a tenant that exhausts its token bucket has its own Adds "
               "shed (TENANT_<name>_SHED) without touching other tenants "
               "or the serving lane. Empty = no quotas")
+define_double("deadline_tighten_ratio", 0.0,
+              "floor fraction of request_deadline_seconds the client "
+              "shrinks minted deadlines toward while the SLO burn engine "
+              "fires (geometric per-mint steps both down and back up, "
+              "every transition flight-recorded) so backlog age tracks "
+              "the error budget. 0 disables: minting is bit-identical to "
+              "the plain request_deadline_seconds path")
 define_double("retry_budget_tokens", 0.0,
               "per-connection retry budget: token bucket capacity spent "
               "by retransmits, read hedges, and layout re-fetches, "

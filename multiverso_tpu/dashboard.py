@@ -136,6 +136,23 @@ def _split_shard(name: str):
     return m.group("pre") + m.group("post"), m.group("idx")
 
 
+# per-tenant counter families (admission + chargeback planes) collapse
+# the same way: TENANT_ctr_SHED becomes mvtpu_tenant_shed_total with a
+# tenant="ctr" label. The suffix alternation is anchored so tenant names
+# containing underscores (including "_default") split unambiguously.
+_TENANT_SERIES = re.compile(
+    r"^TENANT_(?P<tenant>.+)_(?P<suffix>ADMITTED|SHED|BYTES)$")
+
+
+def split_tenant(name: str):
+    """``TENANT_<t>_<SUFFIX>`` -> (``t``, ``SUFFIX``); others ->
+    (None, None)."""
+    m = _TENANT_SERIES.match(name)
+    if m is None:
+        return None, None
+    return m.group("tenant"), m.group("suffix")
+
+
 def _prom_escape(value: str) -> str:
     """Label-value escaping per the Prometheus text exposition format:
     backslash, double-quote and newline."""
@@ -319,13 +336,16 @@ class Dashboard:
             gauges = list(cls._gauges.values())
         ident = cls.identity()
 
-        def lab(shard: Optional[str], le: Optional[str] = None) -> str:
+        def lab(shard: Optional[str], le: Optional[str] = None,
+                tenant: Optional[str] = None) -> str:
             labels = dict(ident)
             if shard is not None:
                 # a per-shard series names its OWN shard — it wins over
                 # the process identity (a launcher holding the fleet's
                 # ROUTER_SHARD<k> series has no shard identity anyway)
                 labels["shard"] = shard
+            if tenant is not None:
+                labels["tenant"] = tenant
             parts = [f'{k}="{_prom_escape(v)}"'
                      for k, v in sorted(labels.items())]
             if le is not None:
@@ -343,6 +363,13 @@ class Dashboard:
                 lines.append(f"# TYPE {n} {kind}")
 
         for c in counters:
+            tenant, suffix = split_tenant(c.name)
+            if tenant is not None:
+                n = _prom_name(f"TENANT_{suffix}")
+                head(n, "counter")
+                lines.append(
+                    f"{n}_total{lab(None, tenant=tenant)} {c.value}")
+                continue
             family, shard = _split_shard(c.name)
             n = _prom_name(family)
             head(n, "counter")

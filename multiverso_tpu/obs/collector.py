@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from multiverso_tpu import config
+from multiverso_tpu.obs.trace import DEFAULT_TENANT
 
 LOCAL_PROCESS = "local"
 
@@ -48,10 +49,13 @@ class StitchedTrace:
     ``hops`` is the causally-ordered list of ``(process, stage,
     t_corrected_ns)`` — corrected onto the collector's local clock.
     ``processes`` is the distinct set of processes the span crossed.
+    ``tenant`` is the chargeback label the submit site stamped on the
+    span (``_default`` when no store tagged it).
     """
 
     req_id: int
     hops: List[Tuple[str, str, int]] = field(default_factory=list)
+    tenant: str = DEFAULT_TENANT
 
     @property
     def processes(self) -> List[str]:
@@ -109,6 +113,21 @@ def _normalize(traces: Any) -> Dict[int, List[Tuple[str, int]]]:
     return out
 
 
+def _normalize_tenants(tags: Any) -> Dict[int, str]:
+    """The optional ``tenants`` sibling key of a ``Control_Traces``
+    payload (same stringified-int-key caveat as the traces dict); legacy
+    senders omit it entirely — an absent/misshapen value is just {}."""
+    out: Dict[int, str] = {}
+    if not isinstance(tags, dict):
+        return out
+    for key, tenant in tags.items():
+        try:
+            out[int(key)] = str(tenant)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
 def estimate_offset(local: Dict[int, List[Tuple[str, int]]],
                     remote: Dict[int, List[Tuple[str, int]]]
                     ) -> Optional[int]:
@@ -153,6 +172,8 @@ class TraceCollector:
         self.include_local = bool(include_local)
         # process name -> {req_id: [(stage, t_ns), ...]}
         self.stores: Dict[str, Dict[int, List[Tuple[str, int]]]] = {}
+        # process name -> {req_id: tenant} (sparse: default omitted)
+        self.tenant_tags: Dict[str, Dict[int, str]] = {}
         # process name -> role string advertised in the reply
         self.roles: Dict[str, str] = {}
         # process name -> estimated clock offset (ns, remote - local)
@@ -203,12 +224,14 @@ class TraceCollector:
             t.join(timeout=self.timeout + 1.0)
 
         self.stores.clear()
+        self.tenant_tags.clear()
         self.roles.clear()
         self.unreachable = []
         if self.include_local:
             from multiverso_tpu.obs.trace import TRACES
             n = max(1, int(config.get_flag("trace_export_max")))
             self.stores[LOCAL_PROCESS] = _normalize(TRACES.export(n))
+            self.tenant_tags[LOCAL_PROCESS] = dict(TRACES.export_tenants(n))
             self.roles[LOCAL_PROCESS] = "client"
         for ep in self.endpoints:
             payload = results.get(ep)
@@ -218,6 +241,8 @@ class TraceCollector:
             role = str(payload.get("role", "unknown"))
             name = f"{role}@{ep}"
             self.stores[name] = _normalize(payload.get("traces"))
+            self.tenant_tags[name] = _normalize_tenants(
+                payload.get("tenants"))
             self.roles[name] = role
         self._estimate_offsets()
         return self
@@ -253,7 +278,14 @@ class TraceCollector:
             # stable sort: equal corrected times keep per-process
             # recording order (hop lists are append-ordered already)
             hops.sort(key=lambda h: h[2])
-            spans.append(StitchedTrace(req_id=rid, hops=hops))
+            tenant = DEFAULT_TENANT
+            for name in self.stores:
+                tag = self.tenant_tags.get(name, {}).get(rid)
+                if tag and tag != DEFAULT_TENANT:
+                    tenant = tag  # first non-default tag wins (the
+                    break         # client submit site tags first)
+            spans.append(StitchedTrace(req_id=rid, hops=hops,
+                                       tenant=tenant))
         spans.sort(key=lambda s: s.start_ns)
         return spans
 

@@ -377,6 +377,28 @@ def fleet_top(endpoints: Sequence[str],
     lines.append("")
     lines.append(engine.render() if engine is not None
                  else "(no SLO engine attached — pass engine=)")
+    tenant_panel = _render_tenants()
+    if tenant_panel:
+        lines.append("")
+        lines.append(tenant_panel)
+    return "\n".join(lines)
+
+
+def _render_tenants(window: float = 30.0) -> str:
+    """The per-tenant rate panel (chargeback plane): windowed admit and
+    shed rates out of the local ``TENANT_<t>_*`` series. Empty string
+    when no tenant traffic was ever recorded — single-tenant fleets keep
+    today's mv.top byte-for-byte."""
+    from multiverso_tpu.obs.timeseries import TIMESERIES
+    admitted = TIMESERIES.tenant_rates("ADMITTED", window)
+    shed = TIMESERIES.tenant_rates("SHED", window)
+    tenants = sorted(set(admitted) | set(shed))
+    if not tenants:
+        return ""
+    lines = [f"{'tenant':<16} {'admit/s':>9} {'shed/s':>9}"]
+    for tenant in tenants:
+        lines.append(f"{tenant:<16} {admitted.get(tenant, 0.0):>9.2f} "
+                     f"{shed.get(tenant, 0.0):>9.2f}")
     return "\n".join(lines)
 
 

@@ -203,6 +203,26 @@ class TimeSeriesRecorder:
                 - int(anchor.counters.get(counter, 0)))
         return d / dt
 
+    def tenant_rates(self, suffix: str,
+                     window_seconds: float) -> Dict[str, float]:
+        """Per-tenant events/second for one ``TENANT_<t>_<SUFFIX>``
+        family (``suffix`` in ``ADMITTED`` / ``SHED`` / ``BYTES``) —
+        the windowed view ``mv.top``'s tenant panel and the autopilot's
+        per-tenant shed sensor read. Tenants are discovered from the
+        newest sample, so a tenant that never emitted is absent (not
+        0.0)."""
+        from multiverso_tpu.dashboard import split_tenant
+        with self._lock:
+            newest = self._ring[-1] if self._ring else None
+        if newest is None:
+            return {}
+        out: Dict[str, float] = {}
+        for name in newest.counters:
+            tenant, suf = split_tenant(name)
+            if tenant is not None and suf == suffix.upper():
+                out[tenant] = self.rate(name, window_seconds)
+        return out
+
     def gauge(self, name: str) -> float:
         """Latest sampled gauge value."""
         with self._lock:

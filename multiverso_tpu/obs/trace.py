@@ -30,6 +30,12 @@ from typing import Any, Dict, List, Optional, Tuple
 
 MAX_HOPS_PER_TRACE = 64
 
+# The tenant every untagged span (and unclaimed table) folds into — the
+# chargeback plane's catch-all bucket. Defined here (the lowest layer
+# that stores tags) so admission, collector and chargeback all share one
+# constant without import cycles.
+DEFAULT_TENANT = "_default"
+
 # Loss counters at the store's bounds, cached Counter objects so the hot
 # path stays one dict hit (Dashboard import is deferred: dashboard.py
 # imports config which must not cycle back through obs at import time).
@@ -55,6 +61,9 @@ class TraceStore:
         self.max_traces = int(max_traces)
         self._traces: "OrderedDict[int, List[Tuple[str, int]]]" = \
             OrderedDict()
+        # req_id -> tenant tag (only NON-default tags are stored; the
+        # map is keyed on live traces, so trace eviction bounds it too)
+        self._tenants: Dict[int, str] = {}
         self._lock = threading.Lock()
 
     def hop(self, req_id: int, stage: str,
@@ -69,7 +78,8 @@ class TraceStore:
             if hops is None:
                 hops = self._traces[req_id] = []
                 while len(self._traces) > self.max_traces:
-                    self._traces.popitem(last=False)
+                    old_rid, _ = self._traces.popitem(last=False)
+                    self._tenants.pop(old_rid, None)
                     evicted += 1
             if len(hops) < MAX_HOPS_PER_TRACE:
                 hops.append((stage, t_ns))
@@ -98,6 +108,30 @@ class TraceStore:
         return {rid: [[stage, t_ns] for stage, t_ns in hops]
                 for rid, hops in self.recent(n)}
 
+    def tag_tenant(self, req_id: int, tenant: str) -> None:
+        """Stamp ``req_id``'s span with its tenant (the submit sites
+        call this right after the first hop). Default-tenant tags are
+        not stored — absence IS the default — and tags for unknown
+        req_ids are dropped, which bounds the map by the trace bound."""
+        if not req_id or not tenant or tenant == DEFAULT_TENANT:
+            return
+        with self._lock:
+            if req_id in self._traces:
+                self._tenants[req_id] = tenant
+
+    def tenant_of(self, req_id: int) -> str:
+        with self._lock:
+            return self._tenants.get(req_id, DEFAULT_TENANT)
+
+    def export_tenants(self, n: int) -> Dict[int, str]:
+        """Tenant tags for the last ``n`` traces — rides next to
+        ``export`` in the ``Control_Traces`` reply (legacy decoders
+        ignore the extra key; legacy senders simply omit it)."""
+        with self._lock:
+            rids = list(self._traces)[-n:]
+            return {rid: self._tenants[rid] for rid in rids
+                    if rid in self._tenants}
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._traces)
@@ -105,6 +139,7 @@ class TraceStore:
     def reset(self) -> None:
         with self._lock:
             self._traces.clear()
+            self._tenants.clear()
 
 
 # Process-global trace store — client and server hops of an in-process
@@ -116,6 +151,12 @@ TRACES = TraceStore()
 def hop(req_id: int, stage: str) -> None:
     """Append one hop to ``req_id``'s trace (no-op for req_id 0)."""
     TRACES.hop(req_id, stage)
+
+
+def tag_tenant(req_id: int, tenant: str) -> None:
+    """Stamp ``req_id``'s span with its resolved tenant (no-op for
+    req_id 0 / the default tenant)."""
+    TRACES.tag_tenant(req_id, tenant)
 
 
 class FlightRecorder:

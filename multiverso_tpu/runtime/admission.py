@@ -53,6 +53,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count
+from multiverso_tpu.obs.trace import DEFAULT_TENANT
 from multiverso_tpu.runtime.message import Message, MsgType
 
 # lane ranks: lower drains first
@@ -214,6 +215,51 @@ class TenantQuotas:
         return (f"shed: tenant '{name}' write quota exhausted "
                 f"(table {table_id})")
 
+    def tenant_of(self, table_id: int) -> str:
+        """The tenant name claiming ``table_id``; unclaimed tables fold
+        into ``DEFAULT_TENANT``."""
+        entry = self._buckets.get(table_id)
+        return entry[0] if entry is not None else DEFAULT_TENANT
+
+    def metered(self, table_id: int) -> bool:
+        return table_id in self._buckets
+
+    def names(self) -> Dict[int, str]:
+        """``{table_id: tenant name}`` for every claimed table — the
+        resolution map :func:`resolve_tenant` caches."""
+        return {tid: name for tid, (name, _) in self._buckets.items()}
+
+
+# resolve_tenant's parse cache: (spec string it was parsed from,
+# {table_id: tenant}). Re-parsed only when the flag's value changes, so
+# the per-request client path pays one flag read + two dict hits.
+_resolve_cache: Tuple[str, Dict[int, str]] = ("", {})
+_resolve_lock = threading.Lock()
+
+
+def resolve_tenant(table_id: int) -> str:
+    """Tenant name owning ``table_id`` under the CURRENT
+    ``tenant_quota_spec`` flag — the shared client-side resolution the
+    trace plane stamps onto spans (``obs/trace.tag_tenant``) at every
+    submit site. Tables no tenant claims — and all traffic when the
+    flag is empty — fold into ``DEFAULT_TENANT``. Purely a labeling
+    read: no token is spent, and a spec that fails to parse resolves
+    everything to the default tenant instead of raising on the request
+    path (the serving gate's ``from_flags`` owns the loud failure)."""
+    global _resolve_cache
+    spec = str(config.get_flag("tenant_quota_spec"))
+    cached_spec, names = _resolve_cache
+    if spec != cached_spec:
+        with _resolve_lock:
+            cached_spec, names = _resolve_cache
+            if spec != cached_spec:
+                try:
+                    names = TenantQuotas.parse(spec).names()
+                except Exception:  # noqa: BLE001 — labeling must not raise
+                    names = {}
+                _resolve_cache = (spec, names)
+    return names.get(int(table_id), DEFAULT_TENANT)
+
 
 class AdmissionGate:
     """Drain-time admission decision, shaped like the replica read gate:
@@ -247,23 +293,34 @@ class AdmissionGate:
         if msg.req_id == 0:
             return None
         if msg.type == MsgType.Request_Add:
+            tenant = self.tenants.tenant_of(msg.table_id)
             text = self.tenants.refusal(msg.table_id)
             if text is not None:
                 count("SHED_ADDS")
                 return text
             if 0 < self.queue_limit < depth:
                 count("SHED_ADDS")
+                count(f"TENANT_{tenant}_SHED")
                 return (f"shed: dispatcher backlog {depth} over "
                         f"admission_queue_limit {self.queue_limit} — "
                         "training writes shed first")
             if self.burn_signal is not None and self.burn_signal():
                 count("SHED_ADDS")
+                count(f"TENANT_{tenant}_SHED")
                 return ("shed: serving SLO burn-rate alert firing — "
                         "training writes shed to protect reads")
+            if not self.tenants.metered(msg.table_id):
+                # metered tables were counted inside TenantQuotas.refusal;
+                # unmetered wire Adds fold into the default tenant so
+                # every admitted write carries exactly one tenant verdict
+                # (the chargeback plane's "Adds admitted" column)
+                count(f"TENANT_{tenant}_ADMITTED")
         elif msg.type == MsgType.Request_Get:
             limit = self.queue_limit * _GET_SHED_FACTOR
             if 0 < limit < depth:
                 count("SHED_GETS")
+                count(f"TENANT_{self.tenants.tenant_of(msg.table_id)}"
+                      "_SHED")
                 return (f"shed: dispatcher backlog {depth} over "
                         f"{_GET_SHED_FACTOR}x admission_queue_limit — "
                         "shedding reads to stay live")

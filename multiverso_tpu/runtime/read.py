@@ -51,7 +51,8 @@ import numpy as np
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_add, gauge_set
 from multiverso_tpu.fault.inject import make_net
-from multiverso_tpu.obs.trace import hop
+from multiverso_tpu.obs.trace import hop, tag_tenant
+from multiverso_tpu.runtime.admission import resolve_tenant
 from multiverso_tpu.runtime import wire
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
 
@@ -526,6 +527,7 @@ class ReadRouter:
         layer up — the shard router — can append their own hops."""
         req_id = self._req_id_source() if self._req_id_source else 0
         hop(req_id, "client_read_submit")
+        tag_tenant(req_id, resolve_tenant(table_id))
         key = (cache_key(table_id, request)
                if self.cache is not None else None)
         if key is not None:

@@ -44,7 +44,7 @@ import signal
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -56,7 +56,8 @@ from multiverso_tpu.fault.inject import make_net
 from multiverso_tpu.fault.retry import (CircuitBreaker, RetryBudget,
                                         RetryPolicy)
 from multiverso_tpu.obs.metrics import StatsSnapshot
-from multiverso_tpu.obs.trace import flight_dump, hop
+from multiverso_tpu.obs.trace import flight_dump, hop, tag_tenant
+from multiverso_tpu.runtime.admission import resolve_tenant
 from multiverso_tpu.runtime.contracts import slot_free
 from multiverso_tpu.runtime.message import Message, MsgType, next_msg_id
 from multiverso_tpu.runtime.net import TcpNet
@@ -655,7 +656,11 @@ class RemoteServer:
             data=wire.encode({"role": "primary",
                               "endpoint": self.endpoint or "",
                               "t_reply_ns": time.time_ns(),
-                              "traces": TRACES.export(n)})))
+                              "traces": TRACES.export(n),
+                              # tenant tags ride as a sibling key legacy
+                              # collectors simply ignore (and legacy
+                              # senders omit — frames are unchanged)
+                              "tenants": TRACES.export_tenants(n)})))
 
     @slot_free
     def _reply_profile(self, msg: Message) -> None:
@@ -1028,6 +1033,66 @@ class RemoteChannel:
         self._client._send(table_id, msg_type, None, next_msg_id(), None)
 
 
+class DeadlineMinter:
+    """Mints the absolute monotonic deadline stamped on every correlated
+    Get/Add from the ``request_deadline_seconds`` budget.
+
+    With ``deadline_tighten_ratio`` > 0 the minted budget tracks the SLO
+    burn engine: while any objective fires, each mint shrinks the
+    effective budget geometrically (``_STEP`` per mint) toward the floor
+    ``ratio x budget`` — backlog age follows the error budget instead of
+    queueing 30-second hopes behind a burning fleet — and when the burn
+    clears, mints recover geometrically back to the full budget. Both
+    transitions are flight-recorded (``deadline_tighten`` /
+    ``deadline_recovered``), every tightened mint counts
+    ``DEADLINE_TIGHTENED``, and the live scale is the ``DEADLINE_SCALE``
+    gauge.
+
+    With ``ratio <= 0`` (the default) ``mint()`` evaluates exactly the
+    legacy expression — bit-identical minting, no metrics touched."""
+
+    _STEP = 0.7  # geometric per-mint step toward the floor (and back)
+
+    def __init__(self, budget: float, ratio: float = 0.0,
+                 burn: Optional[Callable[[], bool]] = None) -> None:
+        self.budget = float(budget)
+        self.ratio = min(1.0, float(ratio))
+        self.scale = 1.0
+        # test seam; None = probe the process-global SLO engine
+        self._burn = burn
+
+    def _burning(self) -> bool:
+        if self._burn is not None:
+            return bool(self._burn())
+        import multiverso_tpu as mv
+        engine = mv.slo_engine()
+        return bool(engine is not None and engine.firing())
+
+    def mint(self) -> float:
+        """The absolute monotonic deadline for one request (0.0 =
+        no deadline)."""
+        if self.ratio <= 0 or self.budget <= 0:
+            return (time.monotonic() + self.budget
+                    if self.budget > 0 else 0.0)
+        scale = self.scale
+        if self._burning():
+            tightened = max(self.ratio, scale * self._STEP)
+            if scale >= 1.0 and tightened < 1.0:
+                flight_dump("deadline_tighten", budget=self.budget,
+                            floor=self.ratio, scale=tightened)
+            scale = tightened
+        elif scale < 1.0:
+            scale = min(1.0, scale / self._STEP)
+            if scale >= 1.0:
+                flight_dump("deadline_recovered", budget=self.budget)
+        if scale < 1.0:
+            count("DEADLINE_TIGHTENED")
+        if scale != self.scale:
+            self.scale = scale
+            gauge_set("DEADLINE_SCALE", scale)
+        return time.monotonic() + self.budget * scale
+
+
 class _Inflight:
     """One outstanding correlated request: the framed message (for
     retransmission) plus its retry clock. ``first`` is the issue time —
@@ -1090,6 +1155,9 @@ class RemoteClient:
         # Defaults leave all three inert.
         self._deadline_budget = float(
             config.get_flag("request_deadline_seconds"))
+        self._minter = DeadlineMinter(
+            self._deadline_budget,
+            float(config.get_flag("deadline_tighten_ratio")))
         self._retry_budget = RetryBudget.from_flags()
         self._breaker = CircuitBreaker.from_flags()
         # set BEFORE the pump starts (the pump observes reply watermarks
@@ -1234,8 +1302,7 @@ class RemoteClient:
         if completion is not None and msg_type in (MsgType.Request_Get,
                                                    MsgType.Request_Add):
             if deadline is None:
-                deadline = (time.monotonic() + self._deadline_budget
-                            if self._deadline_budget > 0 else 0.0)
+                deadline = self._minter.mint()
             if deadline > 0 and deadline <= time.monotonic():
                 # the caller's budget is already gone: spending a round
                 # trip to learn that would be the overload amplifier this
@@ -1277,6 +1344,14 @@ class RemoteClient:
                 self._inflight[msg_id] = _Inflight(msg, time.monotonic())
                 gauge_set("CLIENT_INFLIGHT", len(self._inflight))
                 hop(msg.req_id, "client_send")
+                if msg_type in (MsgType.Request_Get, MsgType.Request_Add):
+                    # chargeback plane: stamp the span with its tenant and
+                    # meter the payload bytes it pushed onto the wire
+                    tenant = resolve_tenant(table_id)
+                    tag_tenant(msg.req_id, tenant)
+                    count(f"TENANT_{tenant}_BYTES",
+                          sum(int(getattr(b, "nbytes", 0) or len(b))
+                              for b in data))
             if self._recovering:
                 # recovery retransmits the whole inflight set (in req_id
                 # order) once re-registered; sending now would race it

@@ -34,7 +34,8 @@ import numpy as np
 
 from multiverso_tpu import config, log
 from multiverso_tpu.dashboard import count, gauge_add, observe
-from multiverso_tpu.obs.trace import hop
+from multiverso_tpu.obs.trace import hop, tag_tenant
+from multiverso_tpu.runtime.admission import resolve_tenant
 from multiverso_tpu.runtime.message import MsgType, next_msg_id
 from multiverso_tpu.shard.partition import (RangePartitioner,
                                             partitioner_from_spec)
@@ -724,6 +725,7 @@ class ShardedClient:
             # _send returns the per-shard span id (0 untraced): tag which
             # shard this leg targeted so a stitched trace shows the fan
             hop(rid, f"router_shard{shard}")
+            tag_tenant(rid, resolve_tenant(table_id))
 
     def _migration_retry(self, table_id: int, msg_type: MsgType,
                          request: Any, completion, attempt: int,
