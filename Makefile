@@ -69,6 +69,14 @@
 #                       tracing, cost attribution + labeled exposition,
 #                       burn-driven deadline tightening, and the live
 #                       two-tenant drill (docs/observability.md §15)
+#   make query          query-plane suite + the word2vec neighbor drill:
+#                       server-side top-k pushdown over every table kind,
+#                       shard merge vs single-shard oracle, replica-served
+#                       queries with zero primary dispatches
+#                       (docs/serving.md §8)
+#   make query-bench    query leg only: tiered cold-scan QPS/p99 with the
+#                       no-promotion proof + replica-served query QPS/p99
+#                       with zero primary dispatches (BENCH_r13.json)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -77,10 +85,10 @@ CHAOS_SEED ?= 7
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
 	audit audit-bench autopilot autopilot-bench overload overload-bench \
-	chargeback clean
+	chargeback query query-bench clean
 
 check: lint native test dryrun profile-smoke tiered audit autopilot \
-	overload chargeback bench
+	overload chargeback query bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -169,6 +177,14 @@ overload-bench:
 chargeback:
 	$(CPU_ENV) $(PYTHON) -m pytest tests/test_chargeback.py -q \
 		-p no:cacheprovider -p no:randomly
+
+query:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_query.py -q \
+		-p no:cacheprovider -p no:randomly
+	$(CPU_ENV) $(PYTHON) examples/word2vec_query.py
+
+query-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --query-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -1463,6 +1463,176 @@ def bench_tiered(key_space=600_000, width=8, ratio=10, ops=40_000,
         shutil.rmtree(tier_dir, ignore_errors=True)
 
 
+def bench_query(key_space=600_000, width=8, ratio=10, n_queries=40,
+                batch=16, k=16, cold_bits=8, rows=4096, cols=32,
+                seconds=4.0, n_readers=4, replicas=2):
+    """Query-plane serving bench (docs/serving.md): two legs of the
+    server-side top-k pushdown.
+
+    Tiered leg: ``query_table`` over a TieredSparseServer holding a
+    table ``ratio``x larger than its hot-tier budget — every query
+    scans the cold segments batch-wise (compressed-domain scoring at
+    ``cold_bits`` >= 4), so QPS/p99 here price the full beyond-RAM
+    scan. The leg also proves the scan is a pure READ of the tier:
+    TIER_PROMOTIONS and the hot/cold hit counters must not move (a
+    query that promoted scanned rows would evict the real working set).
+
+    Replica leg: Zipf-less steady query stream against a 1-shard group
+    with serving read replicas, ``read_preference=replica`` — QPS/p99
+    for replica-served queries plus the proof that the primary
+    dispatched ZERO queries during the window (its
+    SERVER_PROCESS_QUERY_MSG count is flat; fallbacks would show here).
+    Local CPU children: this measures the serving machinery, not
+    silicon."""
+    import shutil
+    import tempfile
+
+    import multiverso_tpu as mv
+    from multiverso_tpu.dashboard import Dashboard
+    from multiverso_tpu.query.engine import query_table
+    from multiverso_tpu.shard.group import ShardGroup
+    from multiverso_tpu.tables.sparse_table import TieredSparseServer
+
+    result = {"query_key_space": key_space, "query_width": width,
+              "query_k": k, "query_batch": batch,
+              "query_replicas": replicas}
+
+    # -- tiered leg: cold-segment scan QPS/p99 + no-promotion proof ----
+    table_bytes = key_space * width * 4
+    resident = table_bytes // ratio
+    tier_dir = tempfile.mkdtemp(prefix="mvquery_bench_")
+    server = TieredSparseServer(key_space, width,
+                                resident_bytes=resident,
+                                cold_bits=cold_bits, tier_dir=tier_dir)
+    try:
+        rng = np.random.default_rng(0)
+        seed_batch = 50_000
+        for start in range(0, key_space, seed_batch):
+            keys = np.arange(start, min(start + seed_batch, key_space),
+                             dtype=np.int64)
+            vals = rng.standard_normal((len(keys), width)).astype(np.float32)
+            server.process_add((keys, vals, None))
+        result["query_tiered_size_ratio"] = round(table_bytes / resident, 2)
+
+        promo0 = Dashboard.counter_value("TIER_PROMOTIONS")
+        hot0 = Dashboard.counter_value("TIER_HOT_HITS")
+        cold0 = Dashboard.counter_value("TIER_COLD_HITS")
+        seg0 = Dashboard.counter_value("QUERY_COLD_SEGMENTS_SCANNED")
+        comp0 = Dashboard.counter_value("QUERY_COMPRESSED_SEGMENTS")
+        lat = []
+        vecs = rng.standard_normal((batch, width)).astype(np.float32)
+        query_table(server, (vecs, k, "dot"))  # warm the jit caches
+        t0 = time.perf_counter()
+        for i in range(n_queries):
+            q = rng.standard_normal((batch, width)).astype(np.float32)
+            tq = time.perf_counter()
+            query_table(server, (q, k, "dot"))
+            lat.append(time.perf_counter() - tq)
+        elapsed = time.perf_counter() - t0
+        result.update({
+            "query_tiered_qps": round(n_queries / elapsed, 1),
+            "query_tiered_p99_ms": round(
+                float(np.percentile(lat, 99)) * 1e3, 2),
+            "query_tiered_cold_segments":
+                Dashboard.counter_value("QUERY_COLD_SEGMENTS_SCANNED") - seg0,
+            "query_tiered_compressed_segments":
+                Dashboard.counter_value("QUERY_COMPRESSED_SEGMENTS") - comp0,
+            # all three must be 0: the scan never promotes and never
+            # touches the tier's hit path, so the hit rate is unchanged
+            "query_tiered_promotions":
+                Dashboard.counter_value("TIER_PROMOTIONS") - promo0,
+            "query_tiered_hot_hits":
+                Dashboard.counter_value("TIER_HOT_HITS") - hot0,
+            "query_tiered_cold_hits":
+                Dashboard.counter_value("TIER_COLD_HITS") - cold0,
+        })
+    finally:
+        server._tier.close()
+        shutil.rmtree(tier_dir, ignore_errors=True)
+
+    # -- replica leg: replica-served QPS/p99 + zero-primary proof ------
+    group = ShardGroup(
+        [{"kind": "matrix", "num_row": rows, "num_col": cols}],
+        shards=1, replicas=replicas,
+        flags={"remote_workers": 8, "heartbeat_seconds": 0.2}).start()
+    try:
+        mv.set_flag("read_staleness_records", 1 << 30)
+        mv.set_flag("client_cache_bytes", 0)  # measure serving, not cache
+        seed_client = group.connect(read_preference="primary")
+        table = seed_client.table(0)
+        base = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+        table.add(base, row_ids=np.arange(rows, dtype=np.int32))
+        deadline = time.monotonic() + 60
+        for fleet in group.replica_endpoints:
+            for ep in fleet:
+                while time.monotonic() < deadline:
+                    probe = mv.watermark(ep)
+                    if probe["watermark"] >= 1 and probe["lag"] == 0:
+                        break
+                    time.sleep(0.1)
+
+        def primary_query_msgs():
+            hist = mv.stats(group.endpoints[0]).histogram(
+                "SERVER_PROCESS_QUERY_MSG")
+            return hist.count if hist else 0
+
+        client = mv.remote_connect(
+            group.endpoints[0],
+            read_endpoints=group.replica_endpoints[0],
+            read_preference="replica")
+        leg_table = client.table(0)
+        served0 = Dashboard.counter_value("QUERIES_VIA_REPLICA")
+        fall0 = Dashboard.counter_value("QUERY_PRIMARY_FALLBACKS")
+        primary0 = primary_query_msgs()
+        counts = [0] * n_readers
+        lats = [[] for _ in range(n_readers)]
+        stop = threading.Event()
+        errors = []
+
+        def reader(idx):
+            gen = np.random.default_rng(100 + idx)
+            while not stop.is_set():
+                try:
+                    q = gen.standard_normal((batch, cols)).astype(np.float32)
+                    tq = time.perf_counter()
+                    leg_table.query(q, k, metric="dot")
+                    lats[idx].append(time.perf_counter() - tq)
+                    counts[idx] += 1
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=reader, args=(i,))
+                   for i in range(n_readers)]
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        client.close()
+        seed_client.close()
+        if errors:
+            raise errors[0]
+        all_lat = [x for per in lats for x in per]
+        result.update({
+            "query_qps_replica": round(sum(counts) / seconds, 1),
+            "query_p99_ms_replica": round(
+                float(np.percentile(all_lat, 99)) * 1e3, 2) if all_lat
+                else None,
+            "query_via_replica":
+                Dashboard.counter_value("QUERIES_VIA_REPLICA") - served0,
+            "query_primary_fallbacks":
+                Dashboard.counter_value("QUERY_PRIMARY_FALLBACKS") - fall0,
+            # the acceptance proof: replica-served queries consume zero
+            # primary dispatches (any fallback would move this count)
+            "query_primary_dispatches": primary_query_msgs() - primary0,
+        })
+    finally:
+        group.stop()
+    return result
+
+
 def bench_autopilot(rows=256, cols=16, zipf_s=1.2, tick_interval=0.5,
                     recover_seconds=2.0, timeout_seconds=45.0):
     """Fleet-autopilot reaction drill (docs/autopilot.md): a TrafficGen
@@ -1889,6 +2059,12 @@ def main():
     except Exception as exc:  # the tiered leg must not sink the figures
         tiered = {"tiered_bench_error": repr(exc)[:300]}
     try:
+        query = bench_query()
+    except Exception as exc:  # the query leg must not sink the figures
+        query = {"query_bench_error": repr(exc)[:300]}
+    if _ATTRIBUTE:
+        _collect_leg_attribution("query", attribution_tables)
+    try:
         prof_overhead = bench_profile_overhead()
     except Exception as exc:  # the profiler leg must not sink the figures
         prof_overhead = {"profile_overhead_error": repr(exc)[:300]}
@@ -1920,6 +2096,7 @@ def main():
         **sharded,
         **read,
         **tiered,
+        **query,
         **prof_overhead,
         **audit,
         "env": _env_fingerprint(),
@@ -2123,6 +2300,12 @@ if __name__ == "__main__":
         # 10x-over-budget table under Zipf, reports hot-tier hit rate
         print(json.dumps(_single_leg_result(
             {"metric": "tiered_hot_hit_rate", **bench_tiered()})))
+    elif "--query-bench" in sys.argv[1:]:
+        # query-plane leg only (`make query-bench` / CI `query` job):
+        # tiered cold-scan QPS/p99 with the no-promotion proof, plus
+        # replica-served query QPS/p99 with zero primary dispatches
+        print(json.dumps(_single_leg_result(
+            {"metric": "query_qps_replica", **bench_query()})))
     elif "--autopilot-bench" in sys.argv[1:]:
         # fleet-autopilot leg only (`make autopilot` drill / operators):
         # Zipf hotspot shift -> time-to-split, p99 recovery, acked-Add

@@ -14,6 +14,7 @@ Public surface (MV_* parity):
     worker_id_to_rank / server_id_to_rank / is_master_worker
     set_flag / parse_cmd_flags
     aggregate                      (MV_Aggregate: in-place-sum allreduce)
+    query                          (server-side top-k retrieval pushdown)
     ArrayTable / MatrixTable / KVTable handles (create_table factory)
     worker(slot)                   (bind a logical worker context to a thread)
 """
@@ -266,6 +267,26 @@ def aggregate(data: Any) -> Any:
     stays on device — the MA-mode fast path; mixing host and device
     values across workers in one round is rejected."""
     return Zoo.instance().aggregate(data)
+
+
+# Bind the retrieval subpackage NOW so the front door below wins the
+# `query` name on this module: once multiverso_tpu.query sits in
+# sys.modules, later imports of it (or its engine) are cache hits and
+# never re-assign the parent attribute over the function.
+from multiverso_tpu import query as _query_plane  # noqa: E402,F401
+
+
+def query(table: Any, vecs: Any, k: int, metric: str = "dot"):
+    """Server-side top-k retrieval pushdown over ``table`` (query/):
+    score every row against the query matrix ``vecs`` ((n_q, dim)
+    float32) under ``metric`` (``dot`` | ``cosine``) and return
+    ``(ids, scores)`` — each (n_q, k') with k' = min(k, rows), ranked
+    score-descending, ties toward the lower global id. Works on any
+    worker-table handle — local, remote, or sharded (the shard router
+    merges per-shard partial top-ks into the identical global answer).
+    Slot-free and replica-servable: results may trail the primary by
+    the read tier's staleness budget (docs/serving.md)."""
+    return table.query(vecs, k, metric=metric)
 
 
 # -- remote table serving (cross-process PS) ---------------------------------

@@ -500,12 +500,13 @@ class WarmStandby:
 class ReplicaReadServer:
     """The replica's slot-free read listener (docs/serving.md).
 
-    Answers exactly seven frame types — ``Request_Read`` (a watermark-
+    Answers exactly eight frame types — ``Request_Read`` (a watermark-
     stamped Get, admission-checked against the request's staleness
-    budget), ``Control_Watermark``, ``Control_Stats``,
-    ``Control_Traces``, ``Control_Profile``, ``Control_Digest`` (the
-    fleet auditor's state-digest probe, obs/audit.py) and heartbeats —
-    and refuses everything else
+    budget), ``Request_Query`` (slot-free top-k retrieval pushdown,
+    admission-checked exactly like a Read), ``Control_Watermark``,
+    ``Control_Stats``, ``Control_Traces``, ``Control_Profile``,
+    ``Control_Digest`` (the fleet auditor's state-digest probe,
+    obs/audit.py) and heartbeats — and refuses everything else
     loudly: a replica is not a write target, and a misdirected Add must
     fail visibly rather than fork state.
     Reads run through the standby's dispatcher-serialized seam, so they
@@ -559,6 +560,8 @@ class ReplicaReadServer:
             return
         if msg.type == MsgType.Request_Read:
             self._serve_read(msg)
+        elif msg.type == MsgType.Request_Query:
+            self._serve_query(msg)
         elif msg.type == MsgType.Control_Watermark:
             self._reply_watermark(msg)
         elif msg.type == MsgType.Control_Stats:
@@ -647,6 +650,46 @@ class ReplicaReadServer:
         hop(msg.req_id, "replica_read_reply_sent")
         self._net.send_via(msg._conn, Message(
             src=0, dst=msg.src, type=MsgType.Reply_Read,
+            table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
+            trace=msg.trace, watermark=int(watermark),
+            data=wire.encode(result, compress=self._compress)))
+
+    @slot_free
+    def _serve_query(self, msg: Message) -> None:
+        """Request_Query on a replica: the same admission gate as a
+        Request_Read (deadline, staleness budget vs replay lag), then
+        the top-k scan runs under the replay-serialized seam so the
+        watermark stamped on the Reply_Query names exactly the state
+        the scan observed. Cold-tier scans never promote rows — a
+        replica's tier residency must track its primary's, not its
+        query traffic."""
+        if 0.0 < msg.deadline < time.monotonic():
+            count("DEADLINE_EXPIRED_DROPS")
+            self._reply_error(msg, "deadline_exceeded: query expired "
+                                   "before the replica served it")
+            return
+        refusal = self._refusal(int(msg.watermark))
+        if refusal is not None:
+            count("REPLICA_READ_REFUSALS")
+            self._reply_error(msg, refusal)
+            return
+        server_table = self._standby._tables.get(msg.table_id)
+        if server_table is None:
+            self._reply_error(msg, f"replica has no table {msg.table_id}")
+            return
+        from multiverso_tpu.query import query_table
+        request = wire.decode(msg.data)
+        hop(msg.req_id, "replica_serve_query")
+
+        def run():
+            return (query_table(server_table, request),
+                    self._standby.applied_watermark)
+
+        result, watermark = self._standby._run(run)
+        count("QUERIES_SERVED_REPLICA")
+        hop(msg.req_id, "replica_query_reply_sent")
+        self._net.send_via(msg._conn, Message(
+            src=0, dst=msg.src, type=MsgType.Reply_Query,
             table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
             trace=msg.trace, watermark=int(watermark),
             data=wire.encode(result, compress=self._compress)))

@@ -1,0 +1,32 @@
+"""Retrieval query plane: server-side top-k scoring pushdown.
+
+The recommender mile the Get/Add planes never covered: score a block of
+query vectors against an embedding table ON the serving process and ship
+back only ``(ids, scores)`` — Li et al. (OSDI 2014) ran user-defined
+functions on server nodes for exactly this shape of work, and shipping a
+10x-over-RAM tiered table to the client to score it there is a
+non-starter by construction.
+
+Wire: the slot-free ``Request_Query``/``Reply_Query`` pair
+(runtime/message.py) carrying ``(vecs, k, metric)``. Serving: the
+:func:`query_table` engine (engine.py) — jitted fused score+top-k for
+dense matrix and sparse row blocks, batch-wise cold-segment scans for
+tiered tables (compressed-domain scoring where ``tier_cold_bits >= 4``,
+never promoting a scanned row). Routing: the shard router merges
+per-shard partials with :func:`merge_topk`; replicas serve queries under
+the same staleness-budget admission as ``Request_Read``
+(docs/serving.md §8).
+"""
+
+__all__ = ["merge_topk", "query_table"]
+
+
+def __getattr__(name):
+    # Lazy re-exports (PEP 562): the package root imports THIS package
+    # eagerly so its `mv.query(...)` front door can shadow the submodule
+    # binding; deferring the engine import keeps that eager bind free of
+    # jax/table imports at `import multiverso_tpu` time.
+    if name in __all__:
+        from multiverso_tpu.query import engine
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

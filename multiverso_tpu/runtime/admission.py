@@ -114,6 +114,11 @@ def lane_of(msg: Message) -> int:
     et al.) stay in arrival order relative to the writes they fence."""
     if msg.type == MsgType.Request_Get and msg.src < 0:
         return LANE_SERVING
+    if msg.type == MsgType.Request_Query:
+        # retrieval queries are slot-free serving traffic whoever sent
+        # them (never clocked, never WAL'd) — they jump the training
+        # backlog exactly like read-tier forwards
+        return LANE_SERVING
     if msg.type in _CONTROL_LANE_TYPES:
         return LANE_CONTROL
     return LANE_TRAINING

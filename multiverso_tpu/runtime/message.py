@@ -143,6 +143,18 @@ class MsgType(enum.IntEnum):
     # diverged server is exactly when every slot is taken
     Control_Digest = 48
     Control_Reply_Digest = -48
+    # retrieval query plane (multiverso_tpu/query/ + docs/serving.md §8):
+    # a slot-free top-k scoring request — query matrix + k + metric
+    # (dot|cosine) ride the payload; like Request_Read it takes NO worker
+    # slot, NO lease and NO dedup entry (queries are idempotent reads),
+    # is served by replicas under the same staleness-budget admission
+    # (the budget rides the request's watermark field), and the reply's
+    # watermark is the serving process's replay/append position. The
+    # value pair sits OUTSIDE the <32 request band on purpose: control-
+    # band framing keeps the v4/v5 wire headers untouched while the
+    # dispatch ladders treat it as a data request.
+    Request_Query = 49
+    Reply_Query = -49
 
     @property
     def is_server_bound(self) -> bool:

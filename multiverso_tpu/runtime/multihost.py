@@ -1033,6 +1033,18 @@ class MultihostRuntime:
         self._check_poison()
         with self._pending_lock:
             self._pending[msg_id] = (completion, seq)
+            # poison() may have drained _pending between the check above
+            # and the insert — a completion registered after the drain
+            # would wait forever. Re-check under the lock the drain
+            # takes: either the drain saw our entry, or we see _poisoned.
+            if self._poisoned is None:
+                return
+            if self._pending.pop(msg_id, None) is None:
+                return  # the drain beat us to it and already failed it
+        if seq:
+            self._window.release(seq)
+        completion.fail(RuntimeError(
+            f"multihost rank poisoned: {self._poisoned}"))
 
     def _pop_pending(self, msg_id: int) -> Optional[Any]:
         with self._pending_lock:

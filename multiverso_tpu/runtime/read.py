@@ -36,6 +36,13 @@ SSP bound, Ho et al. NIPS'13) — plus, for cache hits only, at most
 ``read_lease_seconds`` of wall clock during which the client heard
 nothing newer. Callers that need the primary's exact present read with
 ``read_preference=primary`` (the default — this whole tier is opt-in).
+
+``Request_Query`` (server-side top-k retrieval pushdown, query/) rides
+the same three layers under the same budgets: a namespaced cache key
+(query bytes + k + metric), replica admission against the staleness
+budget, p95-derived hedging, silent primary fallback. A separate
+``QUERY_*`` counter family keeps retrieval traffic legible apart from
+training Gets.
 """
 
 from __future__ import annotations
@@ -296,17 +303,22 @@ class ReplicaReader:
     # -- read path -----------------------------------------------------------
     def read_async(self, table_id: int, request: Any, budget: int,
                    cb: Callable, req_id: int = 0,
-                   trace: bool = False) -> Optional[int]:
+                   trace: bool = False, query: bool = False
+                   ) -> Optional[int]:
         """Fire one read; ``cb(result, watermark, error)`` exactly once
         unless the token is cancelled first. Returns the cancellation
         token (msg_id), or None when the send itself failed (the reader
         marks itself dead; the router moves on). ``req_id``/``trace``
         thread the caller's span through the slot-free frame so the
-        replica's hops land under the same trace id."""
+        replica's hops land under the same trace id. ``query`` sends a
+        ``Request_Query`` (top-k pushdown) instead — same slot-free
+        frame shape, same admission, Reply_Query correlated identically."""
         msg_id = next_msg_id()
         with self._lock:
             self._pending[msg_id] = _PendingRead(cb, time.monotonic())
-        msg = Message(src=-1, dst=0, type=MsgType.Request_Read,
+        msg = Message(src=-1, dst=0,
+                      type=(MsgType.Request_Query if query
+                            else MsgType.Request_Read),
                       table_id=table_id, msg_id=msg_id,
                       req_id=int(req_id), trace=bool(trace),
                       watermark=int(budget),
@@ -360,7 +372,7 @@ class ReplicaReader:
                 continue  # cancelled (hedge loser) or unknown: drop
             latency = time.monotonic() - pend.t0
             self.latencies.append(latency)
-            if msg.type == MsgType.Reply_Read:
+            if msg.type in (MsgType.Reply_Read, MsgType.Reply_Query):
                 try:
                     pend.cb(wire.decode(msg.data), int(msg.watermark), None)
                 except Exception as exc:  # noqa: BLE001 — a decode bug must
@@ -441,7 +453,9 @@ class ReadRouter:
                  cache_bytes: Optional[int] = None,
                  req_id_source: Optional[Callable[[], int]] = None,
                  watermark_confirm: Optional[Callable[[int], None]] = None,
-                 retry_budget: Optional[object] = None) -> None:
+                 retry_budget: Optional[object] = None,
+                 primary_query_submit: Optional[
+                     Callable[[int, Any, Any], None]] = None) -> None:
         self.preference = validate_read_preference(preference)
         # shared per-connection retry budget (fault/retry.py RetryBudget
         # or None): hedges are retries in the budget's ledger — a dry
@@ -451,6 +465,10 @@ class ReadRouter:
         self.budget = int(budget if budget is not None
                           else config.get_flag("read_staleness_records"))
         self._primary_submit = primary_submit
+        # queries fall back through their own primary leg (a direct
+        # Request_Query, not a Get); None = queries are not routable
+        # through this router and submit_query refuses loudly
+        self._primary_query_submit = primary_query_submit
         # Tracing seams (both optional so bare routers stay valid): a
         # req_id source makes every routed Get a traced span; the
         # watermark-confirm callback fires after a REPLICA-served success
@@ -542,6 +560,36 @@ class ReadRouter:
                      req_id).start()
         return req_id
 
+    def submit_query(self, table_id: int, request: Any, completion) -> int:
+        """Serve one top-k query (``Request_Query``) through the same
+        cache → replica → primary ladder as :meth:`submit_get`, counted
+        under ``QUERY_*`` so retrieval traffic reads apart from training
+        Gets on a dashboard. The cache key is namespaced under a
+        ``"query"`` sentinel — (query bytes, k, metric) can never
+        collide with a Get entry — and write-through invalidation,
+        lease expiry and watermark aging apply unchanged."""
+        if self._primary_query_submit is None:
+            completion.fail(RuntimeError(
+                "read tier has no primary query leg (router built "
+                "without primary_query_submit)"))
+            return 0
+        req_id = self._req_id_source() if self._req_id_source else 0
+        hop(req_id, "client_query_submit")
+        tag_tenant(req_id, resolve_tenant(table_id))
+        key = (cache_key(table_id, ("query", request))
+               if self.cache is not None else None)
+        if key is not None:
+            hit = self.cache.lookup(key, self.budget)
+            if hit is not None:
+                count("QUERY_CACHE_HITS")
+                hop(req_id, "client_query_cache_hit")
+                completion.done(hit)
+                return req_id
+            count("QUERY_CACHE_MISSES")
+        _ReadAttempt(self, table_id, request, key, completion,
+                     req_id, query=True).start()
+        return req_id
+
 
 class _ReadAttempt:
     """One routed Get's life: replica attempts, the hedge, deadlines,
@@ -549,17 +597,18 @@ class _ReadAttempt:
 
     __slots__ = ("_router", "_table_id", "_request", "_key", "_completion",
                  "_lock", "_settled", "_tried", "_inflight", "_hedged",
-                 "_fell_back", "_req_id")
+                 "_fell_back", "_req_id", "_query")
 
     def __init__(self, router: ReadRouter, table_id: int, request: Any,
                  key: Optional[Tuple], completion,
-                 req_id: int = 0) -> None:
+                 req_id: int = 0, query: bool = False) -> None:
         self._router = router
         self._table_id = table_id
         self._request = request
         self._key = key
         self._completion = completion
         self._req_id = int(req_id)
+        self._query = bool(query)
         self._lock = threading.Lock()
         self._settled = False
         # queue depth of the read tier: attempts alive between submit
@@ -593,7 +642,8 @@ class _ReadAttempt:
             self._table_id, self._request, self._router.budget,
             lambda result, wm, err, reader=reader:
                 self._on_reply(reader, result, wm, err),
-            req_id=self._req_id, trace=bool(self._req_id))
+            req_id=self._req_id, trace=bool(self._req_id),
+            query=self._query)
         if token is None:
             return self._fire_next()  # send failed; try another
         with self._lock:
@@ -617,7 +667,10 @@ class _ReadAttempt:
             return  # dry retry budget: the first fire keeps running,
             # only the speculative second copy is skipped (denial counted
             # by the budget)
-        count("READ_HEDGES")
+        if self._query:
+            count("QUERY_HEDGES")
+        else:
+            count("READ_HEDGES")
         if not self._fire_next():
             # no second replica available: hedge against the primary
             self._fallback(hedge=True)
@@ -651,7 +704,10 @@ class _ReadAttempt:
                     router.cache.store(self._key, result, watermark)
             if self._settle(result=result,
                             winner=self._find_pair(reader)):
-                count("READS_VIA_REPLICA")
+                if self._query:
+                    count("QUERIES_VIA_REPLICA")
+                else:
+                    count("READS_VIA_REPLICA")
                 hop(self._req_id, "client_read_reply")
                 confirm = router._watermark_confirm
                 if confirm is not None and self._req_id:
@@ -661,10 +717,16 @@ class _ReadAttempt:
                     confirm(self._req_id)
                 if self._hedged and len(self._tried) > 1 \
                         and reader is self._tried[-1]:
-                    count("READ_HEDGE_WINS")
+                    if self._query:
+                        count("QUERY_HEDGE_WINS")
+                    else:
+                        count("READ_HEDGE_WINS")
             return
         if isinstance(error, _Refused):
-            count("READ_REPLICA_REFUSALS_SEEN")
+            if self._query:
+                count("QUERY_REPLICA_REFUSALS_SEEN")
+            else:
+                count("READ_REPLICA_REFUSALS_SEEN")
         with self._lock:
             if self._settled:
                 return
@@ -687,7 +749,10 @@ class _ReadAttempt:
                 return
             self._inflight.remove((reader, token))
         reader.cancel(token)
-        count("READ_REPLICA_TIMEOUTS")
+        if self._query:
+            count("QUERY_REPLICA_TIMEOUTS")
+        else:
+            count("READ_REPLICA_TIMEOUTS")
         if not self._fire_next():
             self._fallback()
 
@@ -699,7 +764,10 @@ class _ReadAttempt:
             if self._settled or self._fell_back:
                 return
             self._fell_back = True
-        count("READ_PRIMARY_FALLBACKS")
+        if self._query:
+            count("QUERY_PRIMARY_FALLBACKS")
+        else:
+            count("READ_PRIMARY_FALLBACKS")
         # The primary path mints its own req_id (primary_submit's 3-arg
         # contract predates tracing); this hop marks the span break so a
         # collector knows the read continued under a fresh id.
@@ -717,8 +785,9 @@ class _ReadAttempt:
             def fail(self, error: BaseException) -> None:
                 self._attempt._settle(error=error)
 
+        submit = (self._router._primary_query_submit if self._query
+                  else self._router._primary_submit)
         try:
-            self._router._primary_submit(self._table_id, self._request,
-                                         _Settle(self))
+            submit(self._table_id, self._request, _Settle(self))
         except Exception as exc:  # noqa: BLE001 — the submit itself died
             self._settle(error=exc)

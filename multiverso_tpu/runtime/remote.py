@@ -175,6 +175,20 @@ class _ReadCompletion:
                     getattr(error, "wire_text", None) or repr(error))
 
 
+class _QueryCompletion(_ReadCompletion):
+    """Completion for a slot-free Request_Query on the primary: replies
+    Reply_Query stamped with the append watermark. Idempotent like a
+    read — no dedup entry; a replayed query just re-scores. The done
+    counter is the query plane's zero-primary-dispatch proof
+    (BENCH_r13 mirrors BENCH_r07's read-tier bar on it)."""
+
+    __slots__ = ()
+
+    def done(self, result: Any) -> None:
+        count("QUERIES_SERVED_PRIMARY")
+        self._reply(MsgType.Reply_Query, result)
+
+
 class RemoteServer:
     """Serves this process's tables to off-mesh clients over TCP."""
 
@@ -542,6 +556,9 @@ class RemoteServer:
         if msg.type == MsgType.Request_Read:
             self._serve_read(msg, compress)
             return
+        if msg.type == MsgType.Request_Query:
+            self._serve_query(msg, compress)
+            return
         if msg.type == MsgType.Control_Register:
             if not self._replayed(msg):
                 self._register_client(msg)
@@ -621,6 +638,24 @@ class RemoteServer:
         hop(msg.req_id, "dispatch_enqueue")
         self._zoo.server.send(Message(
             src=-1, dst=-1, type=MsgType.Request_Get,
+            table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
+            deadline=msg.deadline,
+            data=[request, completion]))
+
+    @slot_free
+    def _serve_query(self, msg: Message, compress: bool) -> None:
+        """Request_Query on the PRIMARY: slot-free like a Request_Read —
+        no worker slot, no lease, no dedup entry. Rides the dispatcher
+        queue under its own type (src=-1, serving lane, never clocked)
+        so the top-k scoring serializes with applies, and the
+        Reply_Query is stamped with the append watermark at reply
+        time. The fallback target when no replica admits the query's
+        staleness budget."""
+        request = wire.decode(msg.data)
+        completion = _QueryCompletion(self, msg._conn, msg, compress)
+        hop(msg.req_id, "dispatch_enqueue")
+        self._zoo.server.send(Message(
+            src=-1, dst=-1, type=MsgType.Request_Query,
             table_id=msg.table_id, msg_id=msg.msg_id, req_id=msg.req_id,
             deadline=msg.deadline,
             data=[request, completion]))
@@ -1185,6 +1220,10 @@ class RemoteClient:
                 self._send(table_id, MsgType.Request_Get, request,
                            next_msg_id(), completion, direct=True)
 
+            def primary_query_submit(table_id, request, completion):
+                self._send(table_id, MsgType.Request_Query, request,
+                           next_msg_id(), completion, direct=True)
+
             self._read_router = ReadRouter(
                 list(read_endpoints), preference, primary_submit,
                 req_id_source=(self._next_req_id if self._trace else None),
@@ -1193,7 +1232,8 @@ class RemoteClient:
                     if self._trace
                     and bool(config.get_flag("trace_read_confirm"))
                     else None),
-                retry_budget=self._retry_budget)
+                retry_budget=self._retry_budget,
+                primary_query_submit=primary_query_submit)
         self._start_maintenance()
 
     # -- lifecycle -----------------------------------------------------------
@@ -1295,12 +1335,20 @@ class RemoteClient:
                     and self._read_tier_ok(table_id)):
                 return self._read_router.submit_get(table_id, request,
                                                     completion)
+            if (msg_type == MsgType.Request_Query and completion is not None
+                    and self._read_tier_ok(table_id)):
+                # top-k pushdown rides the same read tier: replica-first
+                # with budget admission, caching and hedging, primary
+                # fallback via direct=True
+                return self._read_router.submit_query(table_id, request,
+                                                      completion)
             if msg_type == MsgType.Request_Add:
                 # this client just changed the table: its cached reads of
                 # it are suspect (write-through invalidation)
                 self._read_router.note_local_write(table_id)
         if completion is not None and msg_type in (MsgType.Request_Get,
-                                                   MsgType.Request_Add):
+                                                   MsgType.Request_Add,
+                                                   MsgType.Request_Query):
             if deadline is None:
                 deadline = self._minter.mint()
             if deadline > 0 and deadline <= time.monotonic():
@@ -1344,7 +1392,8 @@ class RemoteClient:
                 self._inflight[msg_id] = _Inflight(msg, time.monotonic())
                 gauge_set("CLIENT_INFLIGHT", len(self._inflight))
                 hop(msg.req_id, "client_send")
-                if msg_type in (MsgType.Request_Get, MsgType.Request_Add):
+                if msg_type in (MsgType.Request_Get, MsgType.Request_Add,
+                                MsgType.Request_Query):
                     # chargeback plane: stamp the span with its tenant and
                     # meter the payload bytes it pushed onto the wire
                     tenant = resolve_tenant(table_id)

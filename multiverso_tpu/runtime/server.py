@@ -441,6 +441,8 @@ class Server:
             self._process_add(msg)
         elif msg.type == MsgType.Request_Get:
             self._process_get(msg)
+        elif msg.type == MsgType.Request_Query:
+            self._process_query(msg)
         elif msg.type == MsgType.Server_Execute:
             # administrative callable, serialized with table traffic (used
             # by the multihost lockstep checkpoint path): never clocked,
@@ -469,6 +471,21 @@ class Server:
             hop(msg.req_id, "serve_get")
             result = self._tables[msg.table_id].process_get(request)
             completion.done(result)
+
+    @dispatcher_only
+    def _process_query(self, msg: Message) -> None:
+        """Request_Query: top-k retrieval pushdown (multiverso_tpu/
+        query/). Serialized with applies like a Get — a query observes a
+        consistent table state — but never clocked: it is slot-free
+        administrative traffic on every server flavor (src=-1 bypasses
+        the round gates on the sync server the same way read-tier
+        forwards do)."""
+        from multiverso_tpu.query import query_table
+        with monitor("SERVER_PROCESS_QUERY_MSG"):
+            request, completion = msg.data
+            hop(msg.req_id, "serve_query")
+            completion.done(query_table(self._tables[msg.table_id],
+                                        request))
 
     def _process_finish_train(self, msg: Message) -> None:
         pass  # async server has no clocks to drain

@@ -15,6 +15,11 @@ Split/merge are module-level pure functions (:func:`split_request`) so the
 bit-identical property is testable against real server tables without a
 socket in sight (tests/test_shard.py).
 
+``Request_Query`` (top-k retrieval pushdown, query/) fans out whole: the
+candidate set is the entire table, so every shard scores the same query
+and the merge folds per-shard partial top-ks — ids re-globalized through
+the partitioner — under the engine's ordering contract.
+
 Observability: every fan-out bumps ``ROUTER_FANOUT`` by the number of
 sub-requests, and each sub-request's round trip lands in a per-shard
 histogram ``ROUTER_SHARD<k>_SECONDS`` — a dead shard's failover shows up
@@ -187,6 +192,11 @@ def split_request(kind: str, part, msg_type: MsgType, request: Any,
     shard-local worker identity.
     """
     opt = rewrite_option or (lambda shard, option: option)
+    if msg_type == MsgType.Request_Query:
+        if kind not in ("matrix", "sparse"):
+            log.fatal("router: top-k query is unsupported for %r tables "
+                      "(no row-shaped scorable state)", kind)
+        return _split_query(part, request)
     if kind == "array":
         return _split_array(part, msg_type, request, opt)
     if kind == "matrix":
@@ -196,6 +206,33 @@ def split_request(kind: str, part, msg_type: MsgType, request: Any,
     if kind == "sparse":
         return _split_sparse(part, msg_type, request, params, opt)
     log.fatal("router: unknown table kind %r", kind)
+
+
+def _split_query(part, request):
+    """Top-k pushdown fan-out. There is no id set to route by — the
+    candidate set is the whole table — so every shard scores the SAME
+    ``(vecs, k, metric)`` request against its rows. Per-shard replies
+    carry shard-LOCAL ids (matrix row indices; translated sparse keys);
+    the merge maps them back through the partitioner (``to_global`` is
+    the identity for hash-partitioned sparse keys, which are stored
+    global) and re-imposes the engine's ordering contract — score
+    descending, ties by ascending GLOBAL id — which is what makes the
+    assembled top-k bit-identical to a single-shard oracle, ragged
+    partials (a shard owning fewer than k rows) included."""
+    from multiverso_tpu.query.engine import merge_topk
+    _vecs, k, _metric = request  # validated at the submit entry point
+    parts = [(s, request) for s in range(part.num_shards)]
+
+    def merge(rs):
+        globalized = []
+        for (s, _sub), r in zip(parts, rs):
+            ids = np.asarray(r[0], dtype=np.int64)
+            scores = np.asarray(r[1], dtype=np.float32)
+            globalized.append(
+                (np.asarray(part.to_global(ids, s), dtype=np.int64),
+                 scores))
+        return merge_topk(globalized, int(k))
+    return parts, merge
 
 
 def _split_array(part, msg_type, request, opt):
@@ -412,6 +449,10 @@ def _empty_reply(kind: str, msg_type: MsgType, request: Any,
     batches never touch the wire)."""
     if msg_type == MsgType.Request_Add:
         return None
+    if msg_type == MsgType.Request_Query:
+        n_q = int(np.atleast_2d(np.asarray(request[0])).shape[0])
+        return (np.zeros((n_q, 0), np.int64),
+                np.zeros((n_q, 0), np.float32))
     dtype = np.dtype(params.get("dtype", params.get("value_dtype", "<f4")))
     if kind == "matrix":
         return np.zeros((0, int(params["num_col"])), dtype)
@@ -748,12 +789,14 @@ class ShardedClient:
 
         def handler(mc, idx, shard, error):
             wrong = isinstance(error, WrongShardError)
-            if not wrong and not (msg_type == MsgType.Request_Get
+            idempotent = msg_type in (MsgType.Request_Get,
+                                      MsgType.Request_Query)
+            if not wrong and not (idempotent
                                   and isinstance(error, ConnectionError)):
                 return None
             manifest = error.manifest if wrong else None
             count("ROUTER_REROUTES")
-            if msg_type == MsgType.Request_Get:
+            if idempotent:
                 def rerun():
                     try:
                         self.refresh_layout(manifest)

@@ -47,7 +47,8 @@ import numpy as np
 from multiverso_tpu import log
 from multiverso_tpu import io as mv_io
 from multiverso_tpu.obs.profiler import wait_site
-from multiverso_tpu.utils.quantization import _QBITS, quant_decode, quant_encode
+from multiverso_tpu.utils.quantization import (_QBITS, quant_codes,
+                                               quant_decode, quant_encode)
 
 _SEG_MAGIC = b"MVCS"
 _SEG_VERSION = 1
@@ -202,7 +203,9 @@ class ColdStore:
                         segment)
 
     # -- read path -----------------------------------------------------------
-    def _read_segment(self, segment: int) -> Dict[int, np.ndarray]:
+    def _segment_body(self, segment: int):
+        """Validate + parse one segment file down to its payload:
+        ``(count, width, mode, dtype, keys, body, payload_offset)``."""
         path = self._seg_path(segment)
         with mv_io.get_stream(path, "r") as stream:
             data = stream.read()
@@ -224,12 +227,48 @@ class ColdStore:
         off += dtype_len
         keys = np.frombuffer(body, np.int64, count, off)
         off += count * 8
+        return count, width, mode, dtype, keys, body, off
+
+    def _read_segment(self, segment: int) -> Dict[int, np.ndarray]:
+        count, width, mode, dtype, keys, body, off = \
+            self._segment_body(segment)
         if mode == MODE_QUANT:
             rows = quant_decode(body[off:], count * width)
         else:
             rows = np.frombuffer(body[off:], dtype, count * width)
         rows = rows.reshape(count, width)
         return {int(k): rows[i] for i, k in enumerate(keys)}
+
+    def scan_segments(self):
+        """Read-only batch scan for the query plane
+        (multiverso_tpu/query/): yields one block per segment —
+        ``(keys int64 (n,), rows float32 (n, width) | None, quant)``
+        where ``quant`` is ``(lo, step, bits, codes float32 (n, width))``
+        for quantized segments (raw integer codes, NOT dequantized — the
+        caller scores in the compressed domain) and None otherwise.
+        Only LIVE rows of each segment are yielded (a key superseded by
+        a fresher demotion stays in the old file but not the index).
+        Never touches the fetch cache or the index — the same
+        no-promotion cold iteration :meth:`items` provides, batched."""
+        by_segment: Dict[int, List[int]] = {}
+        for key, segment in self._index.items():
+            by_segment.setdefault(segment, []).append(key)
+        for segment in sorted(by_segment):
+            seg_keys = by_segment[segment]
+            count, width, mode, dtype, keys, body, off = \
+                self._segment_body(segment)
+            pos = {int(k): i for i, k in enumerate(keys)}
+            live_idx = np.asarray([pos[k] for k in seg_keys], np.int64)
+            live = np.asarray(seg_keys, dtype=np.int64)
+            if mode == MODE_QUANT:
+                codes, lo, step, bits = quant_codes(body[off:],
+                                                    count * width)
+                codes = codes.reshape(count, width)[live_idx]
+                yield live, None, (lo, step, bits, codes)
+            else:
+                rows = np.frombuffer(body[off:], dtype, count * width)
+                rows = rows.reshape(count, width)[live_idx]
+                yield live, rows.astype(np.float32, copy=False), None
 
     def fetch(self, key: int) -> Optional[np.ndarray]:
         """Decode the row for ``key``, or None when it is not cold. The

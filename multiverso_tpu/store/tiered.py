@@ -255,6 +255,20 @@ class TieredStore:
         yield from self._hot.items()
         yield from self._cold.items()
 
+    def scan_blocks(self):
+        """Batch scan for the query plane: the hot tier as ONE
+        ``(keys, rows, None)`` block, then one block per cold segment
+        from :meth:`ColdStore.scan_segments` (quantized segments arrive
+        as raw codes for compressed-domain scoring). No tier churn at
+        all — no sketch touches, no promotions, no fetch-cache writes —
+        so a scan leaves the hit-rate exactly where it found it."""
+        if self._hot:
+            keys = np.fromiter(self._hot.keys(), np.int64, len(self._hot))
+            keys.sort()
+            rows = np.stack([self._hot[k] for k in keys.tolist()])
+            yield keys, rows.astype(np.float32, copy=False), None
+        yield from self._cold.scan_segments()
+
     # -- maintenance ---------------------------------------------------------
     def maybe_maintain(self) -> int:
         """Cheap budget probe for the hot mutation path."""

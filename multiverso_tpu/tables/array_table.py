@@ -296,7 +296,13 @@ class ArrayServer(ServerTable):
         # jax.Array input never touches the host (the TPU-era ASGD path —
         # param sync is HBM-to-HBM)
         if not isinstance(delta, jax.Array):
-            delta = async_upload(np.asarray(delta, dtype=self.dtype))
+            host = np.asarray(delta, dtype=self.dtype)
+            if host is delta:
+                # asarray was a no-op, so the enqueued upload would read
+                # the CALLER's buffer — which it may mutate the moment
+                # add_async returns. Snapshot it before going async.
+                host = host.copy()
+            delta = async_upload(host)
         delta = delta.reshape(-1).astype(self.dtype)
         if delta.size != self.size:
             log.fatal("ArrayTable.add: delta size %d != table size %d",

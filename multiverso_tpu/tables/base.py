@@ -146,6 +146,26 @@ class WorkerTable:
         with monitor("WORKER_TABLE_SYNC_ADD"):
             return self.wait(self._submit(MsgType.Request_Add, request))
 
+    def query(self, vecs: Any, k: int, metric: str = "dot") -> Any:
+        """Server-side top-k retrieval pushdown: score every row of the
+        table against ``vecs`` ((n_q, dim) float32) under ``metric``
+        (``dot`` | ``cosine``) and return ``(ids, scores)`` — each
+        (n_q, k') with k' = min(k, rows), ranked score-descending with
+        ties broken toward the lower global id. Slot-free on the server
+        (never clocked, never WAL'd) and replica-servable, so results
+        may trail the primary by the read tier's staleness budget.
+
+        Bypasses wait()/process_reply_get: the reply is already the
+        final (ids, scores) pair — per-kind Get post-processing (e.g.
+        MatrixWorker's buffer fill) must not touch it."""
+        from multiverso_tpu.query.engine import check_request
+        request = check_request((vecs, k, metric))
+        with monitor("WORKER_TABLE_SYNC_QUERY"):
+            completion = Completion()
+            self._channel.submit(self.table_id, MsgType.Request_Query,
+                                 request, next_msg_id(), completion)
+            return completion.wait()
+
     def finish_train(self) -> None:
         """Signal end-of-training so BSP clocks release peers
         (reference: ``Server_Finish_Train``)."""

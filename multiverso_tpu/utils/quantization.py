@@ -205,6 +205,29 @@ def quant_decode(payload: bytes, count: int,
     return (lo + q * step).astype(np.float32)
 
 
+def quant_codes(payload: bytes, count: int):
+    """Unpack a quant payload's integer codes WITHOUT dequantizing:
+    ``(codes float32 (count,), lo, step, bits)``. The compressed-domain
+    scoring path (multiverso_tpu/query/) folds lo/step into the score
+    algebra — ``dot(q, lo + c*step) = lo*sum(q) + step*(q @ c.T)`` —
+    instead of materializing ``lo + c*step`` per element. Codes come
+    back as float32 (the dtype the fold multiplies in); exact, since
+    every code is an integer <= 255."""
+    magic, bits, n = struct.unpack_from("<IIQ", payload, 0)
+    if magic != _QMAGIC or n != count or bits not in _QBITS:
+        raise ValueError("malformed quant payload")
+    lo, step = struct.unpack_from("<ff", payload, 16)
+    per_byte = 8 // bits
+    n_bytes = -(-count // per_byte)
+    packed = np.frombuffer(payload, dtype=np.uint8, offset=24,
+                           count=n_bytes)
+    shifts = (np.arange(per_byte, dtype=np.uint16) * bits)
+    mask = np.uint16((1 << bits) - 1)
+    q = ((packed[:, None].astype(np.uint16) >> shifts) & mask).reshape(-1)
+    return (q[:count].astype(np.float32), np.float32(lo),
+            np.float32(step), int(bits))
+
+
 class QuantizedDelta:
     """Marker a worker proxy hands to the wire codec: an already-encoded
     quant payload riding as one uint8 blob (tag 'quant'); the server side

@@ -505,3 +505,61 @@ def test_shard_group_quantized_adds_route_and_converge():
         np.testing.assert_allclose(got, model, rtol=0, atol=0.05)
         assert np.abs(got - model).max() > 0.0 or True  # lossy by design
         client.close()
+
+
+# -- split_request edges: empty workloads, passthrough, ragged merges ---------
+# (the query plane leans on exactly these seams: docs/serving.md §8)
+
+
+def test_split_request_empty_workloads_never_touch_a_shard(mv_env):
+    from multiverso_tpu.shard.router import _empty_reply
+    params = {"key_space": 50, "width": 3, "dtype": "<f4"}
+    for part in (HashPartitioner(3), RangePartitioner(50, 3)):
+        parts, _merge = split_request(
+            "sparse", part, MsgType.Request_Get,
+            (np.zeros(0, np.int64), None), params)
+        assert parts == []
+    empty = _empty_reply("sparse", MsgType.Request_Get,
+                         (np.zeros(0, np.int64), None), params)
+    assert empty.shape == (0, 3)
+    # the query arm's empty reply is (n_q, 0) — one row per query vector
+    q_empty = _empty_reply("sparse", MsgType.Request_Query,
+                           (np.ones((4, 3), np.float32), 5, "dot"), params)
+    assert q_empty[0].shape == (4, 0) and q_empty[0].dtype == np.int64
+    assert q_empty[1].shape == (4, 0) and q_empty[1].dtype == np.float32
+
+
+def test_split_query_single_shard_passthrough(mv_env):
+    """One shard: the whole request goes to shard 0 unchanged and the
+    merged reply is the shard's reply (ids already global)."""
+    part = RangePartitioner(20, 1)
+    request = (np.ones((2, 4), np.float32), 3, "dot")
+    parts, merge = split_request("matrix", part, MsgType.Request_Query,
+                                 request, {"num_row": 20, "num_col": 4})
+    assert len(parts) == 1 and parts[0][0] == 0
+    assert parts[0][1] is request  # no copy, no translation
+    reply = (np.array([[4, 0, 11], [2, 7, 19]], np.int64),
+             np.array([[9.0, 5.0, 1.0], [8.0, 3.0, 2.0]], np.float32))
+    ids, scores = merge([reply])
+    np.testing.assert_array_equal(ids, reply[0])
+    np.testing.assert_array_equal(scores, reply[1])
+
+
+def test_split_query_merge_aligns_short_shard_replies(mv_env):
+    """A shard owning fewer than k rows replies narrower than k; the
+    merge must still interleave by score with ids re-globalized per
+    shard (ragged-merge alignment)."""
+    part = RangePartitioner(10, 2)  # spans [0, 5) and [5, 10)
+    request = (np.ones((1, 2), np.float32), 3, "dot")
+    parts, merge = split_request("matrix", part, MsgType.Request_Query,
+                                 request, {"num_row": 10, "num_col": 2})
+    assert [shard for shard, _ in parts] == [0, 1]
+    # shard 0 owns one scorable row (local id 2 -> global 2); shard 1
+    # replies a full k=3 (local 0,4,1 -> global 5,9,6)
+    reply0 = (np.array([[2]], np.int64), np.array([[6.0]], np.float32))
+    reply1 = (np.array([[0, 4, 1]], np.int64),
+              np.array([[7.0, 6.0, 1.0]], np.float32))
+    ids, scores = merge([reply0, reply1])
+    # global 9 ties global 2 at 6.0 -> the lower global id ranks first
+    np.testing.assert_array_equal(ids, [[5, 2, 9]])
+    np.testing.assert_array_equal(scores, [[7.0, 6.0, 6.0]])
