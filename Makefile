@@ -77,6 +77,16 @@
 #   make query-bench    query leg only: tiered cold-scan QPS/p99 with the
 #                       no-promotion proof + replica-served query QPS/p99
 #                       with zero primary dispatches (BENCH_r13.json)
+#   make autotune       self-tuning suite: config watch seam, live-knob
+#                       re-reads, sensor fusion, rule table, the
+#                       propose→step→verify→revert controller, the
+#                       autotune-off bit-identity contract
+#                       (docs/autotune.md)
+#   make autotune-bench self-tuning A/B only: hand-tuned-best static
+#                       posture vs the KnobController on the identical
+#                       storm, verdict via --compare with the same-env
+#                       refusal armed (BENCH_r14.json; the tuner's
+#                       audit trail lands in BENCH_autotune_flight.jsonl)
 
 PYTHON ?= python
 CPU_ENV := JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
@@ -85,10 +95,10 @@ CHAOS_SEED ?= 7
 .PHONY: check lint chaos failover sharded replicas reshard metrics-smoke \
 	profile-smoke native test dryrun bench apply-bench read-bench tiered \
 	audit audit-bench autopilot autopilot-bench overload overload-bench \
-	chargeback query query-bench clean
+	chargeback query query-bench autotune autotune-bench clean
 
 check: lint native test dryrun profile-smoke tiered audit autopilot \
-	overload chargeback query bench
+	overload chargeback query autotune bench
 
 lint:
 	$(PYTHON) -m tools.mvlint
@@ -185,6 +195,13 @@ query:
 
 query-bench:
 	$(CPU_ENV) $(PYTHON) bench.py --query-bench
+
+autotune:
+	$(CPU_ENV) $(PYTHON) -m pytest tests/test_autotune.py -q \
+		-p no:cacheprovider -p no:randomly
+
+autotune-bench:
+	$(CPU_ENV) $(PYTHON) bench.py --autotune-bench
 
 clean:
 	$(MAKE) -C multiverso_tpu/native clean

@@ -54,6 +54,7 @@ def init(argv: Optional[Sequence[str]] = None, sync: Optional[bool] = None,
     _configure_profiling()
     _start_metrics_logger()
     _start_observability()
+    _start_autotune()
     return remaining
 
 
@@ -112,6 +113,38 @@ def _stop_observability() -> None:
     if _slo_engine is not None:
         _slo_engine.stop()
         _slo_engine = None
+
+
+_autotuner = None
+
+
+def _start_autotune() -> None:
+    """Start the self-tuning KnobController (tune/) when the
+    ``autotune`` flag is set; idempotent across repeated init(). With
+    the flag off NOTHING is built — no thread, no TUNE_* metrics, the
+    runtime stays bit-identical to an untuned build."""
+    global _autotuner
+    if not bool(get_flag("autotune")) or _autotuner is not None:
+        return
+    from multiverso_tpu.tune import KnobController
+    _autotuner = KnobController()
+    if _autotuner.interval > 0:
+        _autotuner.start()
+
+
+def _stop_autotune() -> None:
+    global _autotuner
+    if _autotuner is not None:
+        _autotuner.stop()
+        _autotuner = None
+
+
+def autotune():
+    """The flag-started self-tuning controller
+    (:class:`~multiverso_tpu.tune.KnobController`) — None unless
+    ``autotune`` was set at init. Tests and drills may also build their
+    own ``KnobController`` directly and drive ``tick_now()``."""
+    return _autotuner
 
 
 def slo_engine():
@@ -181,6 +214,7 @@ def _configure_native_allocator() -> None:
 
 
 def shutdown(finalize_net: bool = True) -> None:
+    _stop_autotune()
     Zoo.instance().stop(finalize_net)
     _stop_profiling()
     _stop_metrics_logger()

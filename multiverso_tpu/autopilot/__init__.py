@@ -47,7 +47,7 @@ from multiverso_tpu.autopilot.actuators import Actuators, AutopilotKilled
 from multiverso_tpu.autopilot.interlock import SafetyInterlock
 from multiverso_tpu.autopilot.policy import AutopilotPolicy, Decision
 from multiverso_tpu.autopilot.sensors import FleetSense, FleetSensors
-from multiverso_tpu.dashboard import count
+from multiverso_tpu.dashboard import count, gauge_set
 from multiverso_tpu.obs.trace import flight_dump
 
 __all__ = ["Autopilot", "AutopilotKilled", "Actuators", "AutopilotPolicy",
@@ -125,6 +125,11 @@ class Autopilot:
         record["action"] = decision.action
         outcome: Optional[Dict[str, Any]] = None
         if decision.action != "none":
+            # action-in-flight signal: other controllers (the autotuner)
+            # must not step knobs while the fleet is being reshaped —
+            # their objective window would measure the reshape, not the
+            # knob. Cleared in the finally even when the action dies.
+            gauge_set("AUTOPILOT_ACTION_INFLIGHT", 1)
             try:
                 outcome = self.actuators.execute(decision)
             except AutopilotKilled as exc:
@@ -138,6 +143,8 @@ class Autopilot:
                 self._stop.set()
                 outcome = {"ok": False, "action": decision.action,
                            "error": str(exc), "killed": True}
+            finally:
+                gauge_set("AUTOPILOT_ACTION_INFLIGHT", 0)
             self.policy.record_action(decision.action, now=now)
             record["outcome"] = outcome
             flight_dump("autopilot_decision",

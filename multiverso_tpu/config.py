@@ -54,6 +54,9 @@ class FlagRegistry:
     def __init__(self) -> None:
         self._flags: Dict[str, _Flag] = {}
         self._lock = threading.RLock()
+        # per-flag change watchers (on_change seam): controllers and cached
+        # hot-path readers subscribe instead of polling get_flag
+        self._watchers: Dict[str, List[Callable[[str, Any], None]]] = {}
 
     # -- registration ------------------------------------------------------
     def define(self, name: str, default: Any, parser: Callable[[str], Any],
@@ -93,17 +96,61 @@ class FlagRegistry:
             except KeyError:
                 raise FlagError(f"unknown flag: {name!r}") from None
             if isinstance(value, str) and not isinstance(flag.default, str):
-                flag.value = flag.parser(value)
+                new = flag.parser(value)
             else:
-                flag.value = type(flag.default)(value)
+                new = type(flag.default)(value)
+            changed = new != flag.value
+            flag.value = new
+        if changed:
+            self._notify(name, new)
 
     def reset(self, name: Optional[str] = None) -> None:
+        changed: List[tuple] = []
         with self._lock:
             if name is None:
                 for f in self._flags.values():
+                    if f.value != f.default:
+                        changed.append((f.name, f.default))
                     f.value = f.default
             else:
-                self._flags[name].value = self._flags[name].default
+                f = self._flags[name]
+                if f.value != f.default:
+                    changed.append((f.name, f.default))
+                f.value = f.default
+        for n, v in changed:
+            self._notify(n, v)
+
+    # -- change watchers ----------------------------------------------------
+    def on_change(self, name: str,
+                  callback: Callable[[str, Any], None]) -> Callable[[], None]:
+        """Subscribe ``callback(name, new_value)`` to value changes of flag
+        ``name`` (fired by set/reset/parse_cmd_flags, only when the value
+        actually changes). Returns an unsubscribe function. Callbacks run
+        OUTSIDE the registry lock (they may read other flags) and their
+        exceptions are swallowed — a broken watcher must not poison set_flag."""
+        with self._lock:
+            if name not in self._flags:
+                raise FlagError(f"unknown flag: {name!r}")
+            self._watchers.setdefault(name, []).append(callback)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                cbs = self._watchers.get(name, [])
+                try:
+                    cbs.remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def _notify(self, name: str, value: Any) -> None:
+        with self._lock:
+            cbs = list(self._watchers.get(name, ()))
+        for cb in cbs:
+            try:
+                cb(name, value)
+            except Exception:
+                pass
 
     def known(self, name: str) -> bool:
         with self._lock:
@@ -126,8 +173,13 @@ class FlagRegistry:
                 key, _, raw = token.lstrip("-").partition("=")
                 with self._lock:
                     flag = self._flags.get(key)
+                    if flag is not None:
+                        new = flag.parser(raw)
+                        changed = new != flag.value
+                        flag.value = new
                 if flag is not None:
-                    flag.value = flag.parser(raw)
+                    if changed:
+                        self._notify(key, new)
                     continue
             remaining.append(token)
         return remaining
@@ -142,6 +194,7 @@ define_string = FLAGS.define_string
 define_double = FLAGS.define_double
 get_flag = FLAGS.get
 set_flag = FLAGS.set
+on_flag_change = FLAGS.on_change
 parse_cmd_flags = FLAGS.parse_cmd_flags
 
 
@@ -204,6 +257,11 @@ define_int("wire_shm_bytes", 4 << 20,
            "shared-memory ring capacity per direction (bytes, rounded to "
            "a multiple of 8); frames larger than the ring stream through "
            "it in chunks")
+define_int("wire_shm_spin", 20,
+           "busy-spin iterations of the shm ring wait ladder before it "
+           "starts yielding (then sleeping): the latency/CPU-burn knob the "
+           "autotuner backs off when shm_ring_spin wait dominates; read "
+           "live on the wait path. 0 = yield immediately")
 define_string("wire_shm_dir", "",
               "directory for shm ring segment files; empty = /dev/shm "
               "when present, else the system temp dir")
@@ -478,6 +536,41 @@ define_bool("autopilot_blue_green", False,
             "mv.clone_fleet canary before executing them live; off, the "
             "autopilot executes directly through the crash-safe "
             "MigrationCoordinator path")
+# Self-tuning runtime (multiverso_tpu/tune/): attribution-driven feedback
+# controller that steps the perf knobs above and reverts regressions
+# (docs/autotune.md).
+define_bool("autotune", False,
+            "start the KnobController inside mv.init: a windowed "
+            "sense→propose→step→verify loop that reads the profiler's "
+            "wait sites + the time-series windows, steps ONE bounded perf "
+            "knob at a time (apply_batch_msgs, wire_coalesce_*, "
+            "wire_quant_bits, wire_shm_spin, read_hedge_ms, "
+            "client_cache_bytes, tier_admit_touches) and reverts any step "
+            "whose windowed objective regresses. Off = bit-identical "
+            "runtime (no thread, no TUNE_* metrics)")
+define_double("autotune_interval_seconds", 2.0,
+              "KnobController tick period; <= 0 disables the background "
+              "thread (tick_now() still works for drills and bench legs)")
+define_double("autotune_window_seconds", 10.0,
+              "observation window the tuner's sensors read wait-site "
+              "deltas, rates and latency quantiles over (also the "
+              "objective's measurement window)")
+define_int("autotune_hysteresis_ticks", 2,
+           "consecutive ticks a dominant cost must hold before the tuner "
+           "steps the mapped knob — one noisy sample must not move a flag")
+define_double("autotune_cooldown_seconds", 10.0,
+              "per-knob cooldown after a committed or reverted step; "
+              "re-proposing inside the window is recorded as a rejected "
+              "alternative in the decision trail")
+define_int("autotune_verify_ticks", 2,
+           "ticks the tuner waits after stepping a knob before comparing "
+           "the windowed objective against the pre-step baseline (the "
+           "verify phase; no other knob moves while one is in flight)")
+define_double("autotune_regress_pct", 5.0,
+              "objective regression tolerance: a stepped knob whose "
+              "verify-phase objective lands more than this percent below "
+              "the pre-step baseline is reverted (TUNE_REVERTS) and its "
+              "direction cooled down; within tolerance it commits")
 # Read-replica serving tier (durable/standby.py serve loop + runtime/read.py
 # client-side cache and routing; docs/serving.md).
 define_int("replicas", 0,

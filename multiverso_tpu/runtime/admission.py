@@ -235,11 +235,20 @@ class TenantQuotas:
         return {tid: name for tid, (name, _) in self._buckets.items()}
 
 
-# resolve_tenant's parse cache: (spec string it was parsed from,
-# {table_id: tenant}). Re-parsed only when the flag's value changes, so
-# the per-request client path pays one flag read + two dict hits.
-_resolve_cache: Tuple[str, Dict[int, str]] = ("", {})
+# resolve_tenant's parse cache: {table_id: tenant}, or None when the
+# spec flag changed since the last parse. Invalidation rides the config
+# watch seam (no per-call flag read / spec compare — the per-request
+# client path pays two dict hits).
+_resolve_cache: Optional[Dict[int, str]] = None
 _resolve_lock = threading.Lock()
+
+
+def _invalidate_resolve(_name: str, _value) -> None:
+    global _resolve_cache
+    _resolve_cache = None
+
+
+config.FLAGS.on_change("tenant_quota_spec", _invalidate_resolve)
 
 
 def resolve_tenant(table_id: int) -> str:
@@ -252,17 +261,17 @@ def resolve_tenant(table_id: int) -> str:
     everything to the default tenant instead of raising on the request
     path (the serving gate's ``from_flags`` owns the loud failure)."""
     global _resolve_cache
-    spec = str(config.get_flag("tenant_quota_spec"))
-    cached_spec, names = _resolve_cache
-    if spec != cached_spec:
+    names = _resolve_cache
+    if names is None:
         with _resolve_lock:
-            cached_spec, names = _resolve_cache
-            if spec != cached_spec:
+            names = _resolve_cache
+            if names is None:
                 try:
-                    names = TenantQuotas.parse(spec).names()
+                    names = TenantQuotas.parse(
+                        str(config.get_flag("tenant_quota_spec"))).names()
                 except Exception:  # noqa: BLE001 — labeling must not raise
                     names = {}
-                _resolve_cache = (spec, names)
+                _resolve_cache = names
     return names.get(int(table_id), DEFAULT_TENANT)
 
 

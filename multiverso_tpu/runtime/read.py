@@ -215,6 +215,16 @@ class ReadCache:
                 self._bytes -= evicted.nbytes
         gauge_set("READ_CACHE_BYTES", self._bytes)
 
+    def resize(self, capacity_bytes: int) -> None:
+        """Live capacity change (flag watch seam): shrinking evicts the
+        LRU tail immediately, growing just raises the bar."""
+        with self._lock:
+            self.capacity = int(capacity_bytes)
+            while self._bytes > self.capacity and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= evicted.nbytes
+        gauge_set("READ_CACHE_BYTES", self._bytes)
+
     def invalidate_table(self, table_id: int) -> None:
         """Write-through invalidation: this client wrote to the table, so
         its own cached reads of it are suspect (read-your-writes at cache
@@ -483,10 +493,37 @@ class ReadRouter:
                   else config.get_flag("client_cache_bytes"))
         self.cache = ReadCache(cap) if cap > 0 else None
         self.timeout = float(config.get_flag("read_timeout_seconds"))
+        # hedge delay pin: cached for the hot path but kept LIVE through
+        # the config watch seam — a runtime set_flag("read_hedge_ms")
+        # (operator or autotuner) takes effect on the next hedge instead
+        # of being silently ignored until the router is rebuilt
         self._hedge_ms = float(config.get_flag("read_hedge_ms"))
+        self._unsubscribe = [config.FLAGS.on_change(
+            "read_hedge_ms", self._on_hedge_ms_change)]
+        if cache_bytes is None:
+            # the cache capacity is flag-derived too: grow/shrink/create
+            # it live (an explicit constructor cap stays pinned)
+            self._unsubscribe.append(config.FLAGS.on_change(
+                "client_cache_bytes", self._on_cache_bytes_change))
         self._scheduler = _Scheduler()
 
+    def _on_hedge_ms_change(self, _name: str, value) -> None:
+        self._hedge_ms = float(value)
+
+    def _on_cache_bytes_change(self, _name: str, value) -> None:
+        cap = int(value)
+        cache = self.cache
+        if cap <= 0:
+            self.cache = None
+        elif cache is None:
+            self.cache = ReadCache(cap)
+        else:
+            cache.resize(cap)
+
     def close(self) -> None:
+        for unsub in getattr(self, "_unsubscribe", ()):
+            unsub()
+        self._unsubscribe = []
         self._scheduler.close()
         for reader in self._readers:
             reader.close()

@@ -167,10 +167,13 @@ class Server:
         # shard-group member.
         self.shard_id = -1
         # micro-batch cap: how many queued Adds one drain may fuse into a
-        # single table apply (0 = legacy per-message dispatch); read once
-        # at construction like the wire coalescing caps
+        # single table apply (0 = legacy per-message dispatch); cached for
+        # the drain loop but LIVE through the config watch seam — the
+        # autotuner (and operators) can step it on a running server
         self._apply_batch_cap = max(0, int(
             config.get_flag("apply_batch_msgs")))
+        self._flag_unsub = config.FLAGS.on_change(
+            "apply_batch_msgs", self._on_batch_cap_change)
         # overload survival (runtime/admission.py): drain-time admission
         # gate (backlog shedding, tenant write quotas, optional SLO burn
         # signal attachable via gate.burn_signal) + lane sorting. Flags
@@ -178,6 +181,9 @@ class Server:
         self.admission = AdmissionGate.from_flags()
         self._lane_sort = (self.reorders_lanes
                            and bool(config.get_flag("priority_lanes")))
+
+    def _on_batch_cap_change(self, _name: str, value) -> None:
+        self._apply_batch_cap = max(0, int(value))
 
     def _ident(self) -> str:
         """Log prefix naming this dispatcher when it is one of many."""
@@ -203,6 +209,9 @@ class Server:
         self._started.wait()
 
     def stop(self) -> None:
+        if getattr(self, "_flag_unsub", None) is not None:
+            self._flag_unsub()
+            self._flag_unsub = None
         self._queue.exit()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -253,9 +262,10 @@ class Server:
     # -- dispatcher --------------------------------------------------------
     def _main(self) -> None:
         self._started.set()
-        fuse = self.fuses_adds and self._apply_batch_cap > 0
         queue_gauge = _apply_metrics()[3]
         while True:
+            # recomputed per drain: the cap is a live knob (watch seam)
+            fuse = self.fuses_adds and self._apply_batch_cap > 0
             # profiler wait site: an idle dispatcher parks here; time in
             # the drain is "no work", everything after is dispatch cost
             _prev_wait = mark_wait("dispatcher_drain")

@@ -84,6 +84,19 @@ _YIELD = 60
 _SLEEP_BASE = 100e-6
 _SLEEP_MAX = 1e-3
 
+# The spin budget is a live knob (``wire_shm_spin``): the autotuner backs
+# it off toward 0 when shm_ring_spin wait dominates the profile. Updated
+# through the config watch seam — the wait path itself never takes the
+# registry lock.
+_spin_live = [max(0, int(config.get_flag("wire_shm_spin")))]
+
+
+def _on_spin_change(_name: str, value) -> None:
+    _spin_live[0] = max(0, int(value))
+
+
+config.FLAGS.on_change("wire_shm_spin", _on_spin_change)
+
 _counter_lock = threading.Lock()
 _counter = [0]
 
@@ -127,12 +140,16 @@ def make_segment_paths() -> tuple:
 
 
 def _sleep_for(idle: int) -> None:
-    if idle < _SPIN:
+    # the ladder keeps its shape under a live spin budget: yield band
+    # width and sleep ramp are unchanged, only the spin edge moves
+    spin = _spin_live[0]
+    yield_end = spin + (_YIELD - _SPIN)
+    if idle < spin:
         return
-    if idle < _YIELD:
+    if idle < yield_end:
         time.sleep(0)
         return
-    time.sleep(min(_SLEEP_BASE * (1 << min((idle - _YIELD) // 64, 4)),
+    time.sleep(min(_SLEEP_BASE * (1 << min((idle - yield_end) // 64, 4)),
                    _SLEEP_MAX))
 
 

@@ -134,8 +134,14 @@ class TieredStore:
             resident_bytes = int(config.get_flag("tier_resident_bytes"))
         if cold_bits is None:
             cold_bits = int(config.get_flag("tier_cold_bits"))
+        self._flag_unsub = None
         if admit_touches is None:
             admit_touches = int(config.get_flag("tier_admit_touches"))
+            # flag-derived admission bar stays LIVE (watch seam): the
+            # autotuner lowers it when tier_cold_fetch wait dominates.
+            # An explicit constructor value stays pinned.
+            self._flag_unsub = config.FLAGS.on_change(
+                "tier_admit_touches", self._on_admit_change)
         self.width = int(width)
         self.dtype = np.dtype(dtype)
         self.row_bytes = self.width * self.dtype.itemsize
@@ -160,6 +166,9 @@ class TieredStore:
             size=4 * max(1024, self.budget // self.row_bytes))
         self._cold = ColdStore(_tier_directory(directory), self.width,
                                self.dtype, cold_bits, table_id)
+
+    def _on_admit_change(self, _name: str, value) -> None:
+        self.admit = max(1, int(value))
 
     # -- accounting ----------------------------------------------------------
     @property
@@ -324,6 +333,9 @@ class TieredStore:
         self._cold.clear()
 
     def close(self) -> None:
+        if self._flag_unsub is not None:
+            self._flag_unsub()
+            self._flag_unsub = None
         self._hot.clear()
         self._tick.clear()
         self._cold.close()

@@ -1,0 +1,467 @@
+"""Self-tuning runtime (multiverso_tpu/tune/): the attribution-driven
+feedback controller over the perf knobs, plus the config watch seam it
+steps through.
+
+Layers under test:
+
+* the ``FlagRegistry.on_change`` watch seam — fires only on actual value
+  change (set/reset/parse_cmd_flags), outside the lock, exception-
+  isolated, unsubscribable;
+* the live-knob sweep — every flag the tuner steps is re-read by its hot
+  path through the seam instead of an init-time snapshot: read-hedge
+  delay, client cache capacity, dispatcher fused-apply cap, shm spin
+  budget, tiered admission bar, tenant-spec resolution cache;
+* the sensors — windowed wait-site differencing and the
+  throughput-weighted-p99 objective;
+* the rule table — actionable-site dominance, bounded geometric steps,
+  the quantization ladder;
+* the controller — propose→step→verify→commit, regression REVERT,
+  hysteresis/cooldown gating, the autopilot pause interlock, and the
+  flight-recorder audit trail every adjustment reconstructs from;
+* the bit-identity contract — ``autotune`` off builds nothing: no
+  thread, zero TUNE_* metrics, byte-identical table state.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import multiverso_tpu as mv
+from multiverso_tpu import config
+from multiverso_tpu.config import FLAGS, FlagError
+from multiverso_tpu.dashboard import Dashboard, gauge_set
+from multiverso_tpu.tune import KnobController
+from multiverso_tpu.tune.rules import (ACTIONABLE_SITES, KnobStep, Rule,
+                                       actionable_dominant, default_rules)
+from multiverso_tpu.tune.sensors import TuneSense, TuneSensors
+
+
+# -- config watch seam --------------------------------------------------------
+
+def test_on_change_fires_on_value_change_only():
+    seen = []
+    config.on_flag_change("read_hedge_ms",
+                          lambda name, value: seen.append((name, value)))
+    mv.set_flag("read_hedge_ms", config.get_flag("read_hedge_ms"))
+    assert seen == []                       # same value: no fire
+    mv.set_flag("read_hedge_ms", 123)
+    assert seen == [("read_hedge_ms", 123.0)]   # coerced value delivered
+    mv.set_flag("read_hedge_ms", 123)
+    assert len(seen) == 1                   # redundant set: no fire
+    FLAGS.reset()
+    assert seen[-1] == ("read_hedge_ms", 0.0)   # reset fires too
+
+
+def test_on_change_unsubscribe_and_unknown_flag():
+    seen = []
+    unsub = config.on_flag_change("read_hedge_ms",
+                                  lambda n, v: seen.append(v))
+    mv.set_flag("read_hedge_ms", 5)
+    unsub()
+    mv.set_flag("read_hedge_ms", 9)
+    assert seen == [5.0]
+    with pytest.raises(FlagError):
+        config.on_flag_change("no_such_flag", lambda n, v: None)
+
+
+def test_on_change_exception_does_not_poison_set_flag():
+    seen = []
+
+    def broken(_name, _value):
+        raise RuntimeError("watcher bug")
+
+    config.on_flag_change("read_hedge_ms", broken)
+    config.on_flag_change("read_hedge_ms", lambda n, v: seen.append(v))
+    mv.set_flag("read_hedge_ms", 7)         # must not raise
+    assert config.get_flag("read_hedge_ms") == 7.0
+    assert seen == [7.0]                    # later watchers still fire
+
+
+def test_parse_cmd_flags_fires_watchers():
+    seen = []
+    config.on_flag_change("apply_batch_msgs", lambda n, v: seen.append(v))
+    config.parse_cmd_flags(["-apply_batch_msgs=33"])
+    assert seen == [33]
+
+
+# -- live knobs: hot paths re-read through the seam ---------------------------
+
+def test_shm_spin_budget_is_live():
+    from multiverso_tpu.runtime import shm
+    assert shm._spin_live[0] == int(config.get_flag("wire_shm_spin"))
+    mv.set_flag("wire_shm_spin", 0)
+    assert shm._spin_live[0] == 0
+    mv.set_flag("wire_shm_spin", 64)
+    assert shm._spin_live[0] == 64
+
+
+def test_server_apply_batch_cap_is_live():
+    from multiverso_tpu.runtime.server import Server
+    srv = Server(num_workers=1)
+    try:
+        assert srv._apply_batch_cap == int(
+            config.get_flag("apply_batch_msgs"))
+        mv.set_flag("apply_batch_msgs", 7)
+        assert srv._apply_batch_cap == 7
+        mv.set_flag("apply_batch_msgs", 0)
+        assert srv._apply_batch_cap == 0
+    finally:
+        srv.stop()
+    mv.set_flag("apply_batch_msgs", 99)     # stopped server: unsubscribed
+    assert srv._apply_batch_cap == 0
+
+
+def test_read_router_hedge_and_cache_are_live():
+    from multiverso_tpu.runtime.read import ReadCache, ReadRouter
+    mv.set_flag("client_cache_bytes", 0)
+    router = ReadRouter([], "primary", primary_submit=lambda *a: None)
+    try:
+        assert router.cache is None
+        mv.set_flag("read_hedge_ms", 250)
+        assert router._hedge_ms == 250.0
+        mv.set_flag("client_cache_bytes", 1 << 20)   # created live
+        assert isinstance(router.cache, ReadCache)
+        assert router.cache.capacity == 1 << 20
+        mv.set_flag("client_cache_bytes", 4096)      # shrunk live
+        assert router.cache.capacity == 4096
+        mv.set_flag("client_cache_bytes", 0)         # dropped live
+        assert router.cache is None
+    finally:
+        router.close()
+    mv.set_flag("read_hedge_ms", 999)       # closed router: unsubscribed
+    assert router._hedge_ms == 250.0
+
+
+def test_read_router_explicit_cache_cap_stays_pinned():
+    from multiverso_tpu.runtime.read import ReadRouter
+    router = ReadRouter([], "primary", primary_submit=lambda *a: None,
+                        cache_bytes=8192)
+    try:
+        mv.set_flag("client_cache_bytes", 1 << 20)
+        assert router.cache.capacity == 8192
+    finally:
+        router.close()
+
+
+def test_tiered_admit_bar_is_live(tmp_path):
+    from multiverso_tpu.store.tiered import TieredStore
+    store = TieredStore(width=4, dtype=np.float32,
+                        resident_bytes=1 << 20, directory=str(tmp_path))
+    try:
+        assert store.admit == int(config.get_flag("tier_admit_touches"))
+        mv.set_flag("tier_admit_touches", 1)
+        assert store.admit == 1
+    finally:
+        store.close()
+    pinned = TieredStore(width=4, dtype=np.float32, resident_bytes=1 << 20,
+                         directory=str(tmp_path / "b"), admit_touches=5)
+    try:
+        mv.set_flag("tier_admit_touches", 2)
+        assert pinned.admit == 5            # explicit value stays pinned
+    finally:
+        pinned.close()
+
+
+def test_resolve_tenant_cache_invalidates_on_spec_change():
+    from multiverso_tpu.runtime.admission import resolve_tenant
+    mv.set_flag("tenant_quota_spec", "alpha:tables=0,qps=10")
+    assert resolve_tenant(0) == "alpha"
+    mv.set_flag("tenant_quota_spec", "beta:tables=0,qps=10")
+    assert resolve_tenant(0) == "beta"      # cache dropped, not stale
+
+
+# -- sensors ------------------------------------------------------------------
+
+class _Profiler:
+    def __init__(self):
+        self.cumulative = {}
+
+    def wait_seconds(self):
+        return dict(self.cumulative)
+
+
+class _Hist:
+    def __init__(self, count, p99):
+        self.count = count
+        self._p99 = p99
+
+    def quantile(self, q):
+        return self._p99
+
+
+class _Recorder:
+    """TimeSeriesRecorder stand-in driven by plain dicts."""
+
+    def __init__(self):
+        self.rates = {}
+        self.gauges = {}
+        self.hist = None
+
+    def rate(self, name, window):
+        return float(self.rates.get(name, 0.0))
+
+    def gauge(self, name):
+        return float(self.gauges.get(name, 0.0))
+
+    def window_histogram(self, name, window):
+        return self.hist
+
+
+def _sensors(profiler=None, recorder=None, window=10.0):
+    return TuneSensors(recorder=recorder or _Recorder(),
+                       profiler=profiler or _Profiler(), window=window)
+
+
+def test_sensors_difference_wait_sites_per_window():
+    prof = _Profiler()
+    sensors = _sensors(profiler=prof)
+    prof.cumulative = {"wal_fsync": 2.0, "net_recv": 0.5}
+    first = sensors.read(now=1.0)
+    assert first.wait == {"wal_fsync": 2.0, "net_recv": 0.5}
+    prof.cumulative = {"wal_fsync": 2.1, "net_recv": 3.5}
+    second = sensors.read(now=2.0)
+    assert second.wait == pytest.approx({"wal_fsync": 0.1,
+                                         "net_recv": 3.0})
+    assert second.dominant_wait == "net_recv"
+
+
+def test_sensors_objective_is_throughput_weighted_p99():
+    rec = _Recorder()
+    rec.hist = _Hist(count=500, p99=0.025)
+    sense = _sensors(recorder=rec).read(now=1.0)
+    assert sense.throughput == pytest.approx(50.0)      # 500 / 10s window
+    assert sense.objective == pytest.approx(50.0 / 0.025)
+    rec.hist = None
+    assert _sensors(recorder=rec).read(now=1.0).objective == 0.0
+
+
+# -- rule table ---------------------------------------------------------------
+
+def _sense(**kw):
+    return TuneSense(**kw)
+
+
+def test_dominance_is_judged_among_actionable_sites():
+    # an idle park (dispatcher_drain) dwarfing every real cost must not
+    # blind the tuner: wal_fsync still wins among ACTIONABLE_SITES
+    s = _sense(wait={"dispatcher_drain": 9.0, "wal_fsync": 0.4,
+                     "net_recv": 0.1},
+               dominant_wait="dispatcher_drain", dominant_wait_seconds=9.0)
+    assert actionable_dominant(s) == ("wal_fsync", 0.4)
+    rule = next(r for r in default_rules() if r.name == "wal_fsync")
+    assert rule.predicate(s) is not None
+    assert "dispatcher_drain" not in ACTIONABLE_SITES
+    quiet = _sense(wait={"wal_fsync": 0.001})
+    assert actionable_dominant(quiet) == ("", 0.0)       # below the floor
+    assert rule.predicate(quiet) is None
+
+
+def test_knob_step_bounds_and_ladder():
+    up = KnobStep("apply_batch_msgs", "up", hi=64, seed=8)
+    assert up.propose(0, _sense()) == 8                  # seeds from 0
+    assert up.propose(8, _sense()) == 16                 # doubles
+    assert up.propose(48, _sense()) == 64                # clamps at hi
+    assert up.propose(64, _sense()) is None              # pinned
+    down = KnobStep("wire_shm_spin", "down", lo=1)
+    assert down.propose(8, _sense()) == 4
+    assert down.propose(1, _sense()) is None
+    ladder = KnobStep("wire_quant_bits", "ladder", ladder=(0, 8, 4, 2, 1))
+    assert ladder.propose(0, _sense()) == 8
+    assert ladder.propose(4, _sense()) == 2
+    assert ladder.propose(1, _sense()) is None           # bottom rung
+
+
+def test_hedge_rule_seeds_from_effective_delay():
+    rule = next(r for r in default_rules() if r.name == "hedge")
+    s = _sense(hedge_rate=10.0, hedge_win_rate=1.0,
+               hedge_delay_seconds=0.004)
+    assert rule.predicate(s) is not None
+    assert rule.steps[0].propose(0, s) == pytest.approx(8.0)  # 2x in ms
+    healthy = _sense(hedge_rate=10.0, hedge_win_rate=9.0)
+    assert rule.predicate(healthy) is None
+
+
+# -- controller ---------------------------------------------------------------
+
+class _ScriptedSensors:
+    """Sensor stand-in: each read() pops the next scripted TuneSense."""
+
+    def __init__(self, senses):
+        self.senses = list(senses)
+        self.reads = 0
+
+    def read(self, now=None):
+        self.reads += 1
+        sense = self.senses.pop(0) if self.senses else _sense()
+        sense.now = float(now or 0.0)
+        return sense
+
+
+def _pressure(objective):
+    return _sense(wait={"x": 1.0}, objective=objective)
+
+
+def _rule(hi=64):
+    return Rule("drill", lambda s: ("pressure" if s.wait.get("x") else None),
+                [KnobStep("apply_batch_msgs", "up", hi=hi, seed=8)])
+
+
+def _controller(senses, **kw):
+    mv.set_flag("apply_batch_msgs", 0)      # the drill knob seeds from 0
+    kw.setdefault("hysteresis", 1)
+    kw.setdefault("verify_ticks", 1)
+    kw.setdefault("cooldown", 100.0)
+    kw.setdefault("regress_pct", 5.0)
+    return KnobController(sensors=_ScriptedSensors(senses),
+                          rules=[_rule()], interval=0, **kw)
+
+
+def test_controller_steps_then_commits():
+    ctl = _controller([_pressure(100.0), _pressure(100.0),
+                       _pressure(100.0)])
+    r1 = ctl.tick_now(now=1.0)
+    assert r1["action"] == "step"
+    assert config.get_flag("apply_batch_msgs") == 8
+    r2 = ctl.tick_now(now=2.0)
+    assert r2["action"] == "commit"
+    assert config.get_flag("apply_batch_msgs") == 8      # change kept
+    assert (ctl.steps, ctl.commits, ctl.reverts) == (1, 1, 0)
+    # the knob is now cooling down: a fresh match cannot re-step it
+    r3 = ctl.tick_now(now=3.0)
+    assert r3["action"] == "none"
+    assert any("cooling down" in rej["reason"] for rej in r3["rejected"])
+    assert Dashboard.gauge_value("TUNE_APPLY_BATCH_MSGS") == 8.0
+    assert Dashboard.counter_value("TUNE_STEPS") >= 1
+    assert Dashboard.counter_value("TUNE_COMMITS") >= 1
+
+
+def test_controller_reverts_on_objective_regression():
+    ctl = _controller([_pressure(100.0), _pressure(50.0)])
+    ctl.tick_now(now=1.0)
+    assert config.get_flag("apply_batch_msgs") == 8
+    r2 = ctl.tick_now(now=2.0)
+    assert r2["action"] == "revert"
+    assert config.get_flag("apply_batch_msgs") == 0      # rolled back
+    assert r2["verdict"]["objective"] < r2["verdict"]["regress_bar"]
+    assert ctl.reverts == 1 and ctl.commits == 0
+    assert Dashboard.counter_value("TUNE_REVERTS") >= 1
+    assert Dashboard.gauge_value("TUNE_APPLY_BATCH_MSGS") == 0.0
+
+
+def test_stop_aborts_unverified_inflight_step():
+    # a step the controller never judged must not outlive it as silent
+    # live state — stop() rolls it back and flight-records the abort
+    ctl = _controller([_pressure(100.0)])
+    ctl.tick_now(now=1.0)
+    assert config.get_flag("apply_batch_msgs") == 8      # step live
+    ctl.stop()
+    assert config.get_flag("apply_batch_msgs") == 0      # rolled back
+    assert ctl.reverts == 1 and ctl._inflight is None
+    assert ctl.abort_inflight() is False                 # idempotent
+
+
+def test_controller_tolerates_regression_within_bar():
+    # a dip smaller than autotune_regress_pct is noise, not a verdict
+    ctl = _controller([_pressure(100.0), _pressure(97.0)])
+    ctl.tick_now(now=1.0)
+    assert ctl.tick_now(now=2.0)["action"] == "commit"
+
+
+def test_controller_hysteresis_requires_a_streak():
+    ctl = _controller([_pressure(100.0), _pressure(100.0)], hysteresis=2)
+    r1 = ctl.tick_now(now=1.0)
+    assert r1["action"] == "none"           # 1/2: matched but barred
+    assert any("hysteresis" in rej["reason"] for rej in r1["rejected"])
+    assert ctl.tick_now(now=2.0)["action"] == "step"
+
+
+def test_controller_pauses_while_autopilot_is_busy():
+    ctl = _controller([_pressure(100.0), _pressure(100.0)])
+    for gauge in ("AUTOPILOT_FROZEN", "AUTOPILOT_ACTION_INFLIGHT"):
+        gauge_set(gauge, 1)
+        record = ctl.tick_now(now=1.0)
+        assert record["action"] == "paused"
+        assert ctl.sensors.reads == 0       # no sense, no knob motion
+        gauge_set(gauge, 0)
+    assert Dashboard.counter_value("TUNE_PAUSED_TICKS") == 2
+    # a pause mid-verify freezes the verify window instead of judging a
+    # window that spans another controller's action
+    ctl.tick_now(now=2.0)                   # step goes in flight
+    gauge_set("AUTOPILOT_FROZEN", 1)
+    ctl.tick_now(now=3.0)
+    assert ctl._inflight is not None
+    assert ctl._inflight.ticks_waited == 0
+    gauge_set("AUTOPILOT_FROZEN", 0)
+    assert ctl.tick_now(now=4.0)["action"] == "commit"
+
+
+def test_flight_recorder_reconstructs_every_adjustment(tmp_path):
+    path = tmp_path / "flight.jsonl"
+    mv.set_flag("flight_recorder_path", str(path))
+    mv.set_flag("apply_batch_msgs", 0)
+    senses = [_pressure(100.0), _pressure(10.0),    # step -> revert
+              _pressure(100.0), _pressure(100.0)]   # step -> commit
+    ctl = KnobController(sensors=_ScriptedSensors(senses), rules=[_rule()],
+                         interval=0, hysteresis=1, verify_ticks=1,
+                         cooldown=0.0, regress_pct=5.0)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        ctl.tick_now(now=t)
+    events = [json.loads(line) for line in path.read_text().splitlines()
+              if '"kind": "event"' in line]
+    tune = [e for e in events if e["reason"].startswith("tune_")]
+    assert [e["reason"] for e in tune] == [
+        "tune_step", "tune_revert", "tune_step", "tune_commit"]
+    # replaying the trail reproduces the live flag value exactly
+    value = 0
+    for event in tune:
+        value = event["old"] if event["reason"] == "tune_revert" \
+            else event["new"]
+    assert value == config.get_flag("apply_batch_msgs") == 8
+    for event in tune:                       # every record self-describes
+        assert event["flag"] == "apply_batch_msgs"
+        assert "baseline" in event or "regress_bar" in event
+
+
+# -- bit-identity with autotune off -------------------------------------------
+
+def _apply_workload():
+    mv.init(heartbeat_seconds=0)
+    table = mv.create_table("matrix", num_row=128, num_col=16)
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        ids = np.sort(rng.choice(128, 32, replace=False)).astype(np.int32)
+        table.add(rng.standard_normal((32, 16)).astype(np.float32) * 0.01,
+                  row_ids=ids)
+    out = np.asarray(table.get(), np.float32).tobytes()
+    mv.shutdown()
+    FLAGS.reset()
+    return out
+
+
+def test_autotune_off_is_bit_identical_and_silent():
+    assert bool(config.get_flag("autotune")) is False    # default OFF
+    Dashboard.reset()                        # TUNE_* registered by other
+    first = _apply_workload()                # tests must read back as 0
+    second = _apply_workload()
+    assert first == second                   # byte-identical state
+    assert mv.autotune() is None             # nothing was built
+    emitted = {n: Dashboard.counter_value(n) for n in Dashboard._counters
+               if n.startswith("TUNE_")}
+    emitted.update({n: Dashboard.gauge_value(n) for n in Dashboard._gauges
+                    if n.startswith("TUNE_")})
+    assert all(v == 0 for v in emitted.values()), emitted
+
+
+def test_init_flag_builds_and_shutdown_tears_down():
+    # interval 0: the controller is built but not threaded — drills and
+    # tests own the cadence through tick_now()
+    mv.init(autotune=True, autotune_interval_seconds=0,
+            heartbeat_seconds=0)
+    ctl = mv.autotune()
+    assert ctl is not None and not ctl.status()["running"]
+    record = ctl.tick_now(now=1.0)
+    assert record["tick"] == 1
+    assert Dashboard.counter_value("TUNE_TICKS") >= 1
+    mv.shutdown()
+    assert mv.autotune() is None
